@@ -1,0 +1,35 @@
+"""tpu-sdnmpi: a TPU-native SDN-MPI routing framework.
+
+A from-scratch rebuild of the capabilities of keichi/sdn-mpi-router
+(reference mounted at /root/reference) designed TPU-first:
+
+- The controller state (switch/link/host topology, per-link utilization,
+  installed flows, MPI rank registry) lives in small host-side stores with
+  the same semantics as the reference's ``TopologyDB`` / ``SwitchFDB`` /
+  ``RankAllocationDB`` (reference: sdnmpi/util/*.py).
+- The path oracle — the reference's per-flow Python DFS/BFS
+  (reference: sdnmpi/util/topology_db.py:59-122) — is a batched JAX program:
+  topology adjacency and utilization are dense ``[V, V]`` device tensors, and
+  all-pairs shortest paths / next-hop matrices / congestion-aware ECMP are
+  computed under ``jit`` with MXU-friendly boolean matmul BFS and min-plus
+  iterations, scoring every rank pair of an MPI collective at once.
+- The control plane (event bus, router, topology manager, process manager,
+  monitor, WebSocket JSON-RPC mirror) mirrors the reference's five-app
+  decomposition (reference: sdnmpi/{router,topology,process,monitor,
+  rpc_interface}.py) on plain asyncio instead of Ryu.
+
+Package map:
+  core/         state stores (TopologyDB, SwitchFDB, RankAllocationDB)
+  oracle/       JAX routing kernels (APSP, next-hop, paths, congestion)
+  collectives/  MPI collective rank-pair batch generators
+  control/      event bus, apps, simulated switch fabric
+  api/          WebSocket JSON-RPC mirror, snapshots/checkpointing
+  topogen/      topology generators (linear, fat-tree, dragonfly, torus)
+  parallel/     device-mesh sharding of the oracle
+  protocol/     wire codecs (announcement sideband, virtual MAC, flow msgs)
+  utils/        MAC helpers, tracing, logging
+"""
+
+__version__ = "0.1.0"
+
+from sdnmpi_tpu.config import Config  # noqa: F401

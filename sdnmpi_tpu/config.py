@@ -1,0 +1,64 @@
+"""Framework configuration.
+
+The reference scatters its knobs across hard-coded constants (UDP port 61000
+at sdnmpi/process.py:70,103 and sdnmpi/topology.py:128; flow priorities
+0xffff/0xfffe at sdnmpi/process.py:78 and sdnmpi/topology.py:91,107;
+MONITOR_INTERVAL at sdnmpi/monitor.py:24) and selects behavior by which apps
+``ryu-manager`` loads. Here everything is one dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+
+@dataclasses.dataclass
+class Config:
+    # --- wire protocol ---------------------------------------------------
+    #: UDP destination port of the MPI process announcement sideband
+    #: (reference: sdnmpi/process.py:70).
+    announcement_port: int = 61000
+
+    # --- flow priorities (reference: process.py:78, topology.py:91,107) --
+    #: announcement -> controller and IPv6-multicast drop rules
+    priority_control: int = 0xFFFF
+    #: broadcast -> controller rule
+    priority_broadcast: int = 0xFFFE
+    #: normal unicast path rules (OFP_DEFAULT_PRIORITY in the reference,
+    #: sdnmpi/router.py:60)
+    priority_default: int = 0x8000
+
+    # --- monitoring ------------------------------------------------------
+    #: seconds between port-stats polls (reference: sdnmpi/monitor.py:24)
+    monitor_interval: float = 1.0
+
+    # --- oracle ----------------------------------------------------------
+    #: routing backend: "jax" (device tensors, batched) or "py"
+    #: (pure-Python BFS used for differential testing)
+    oracle_backend: Literal["jax", "py"] = "jax"
+    #: pad switch count to the next multiple of this (static shapes for jit)
+    switch_pad_multiple: int = 8
+    #: upper bound on shortest-path hop count (RouteOracle/apsp_distances);
+    #: the lax.while_loop exits earlier when the frontier converges, so
+    #: this is a safety bound, not a cost. 0 = no bound (iterate up to V).
+    max_diameter: int = 0
+    #: maximum hops materialized when reconstructing a path into an fdb
+    max_path_len: int = 32
+    #: weight of link utilization when scoring congestion-aware routes
+    congestion_alpha: float = 1.0
+    #: rounds of re-balancing when assigning ECMP next-hops to a flow batch
+    ecmp_rounds: int = 3
+
+    # --- api -------------------------------------------------------------
+    #: WebSocket JSON-RPC mirror bind address (reference serves
+    #: /v1.0/sdnmpi/ws via Ryu's WSGI server, sdnmpi/rpc_interface.py:104)
+    rpc_host: str = "127.0.0.1"
+    rpc_port: int = 8080
+    rpc_path: str = "/v1.0/sdnmpi/ws"
+
+    #: run the monitor app (reference: run_router_no_monitor.sh omits it)
+    enable_monitor: bool = True
+
+
+DEFAULT_CONFIG = Config()

@@ -1,0 +1,9 @@
+from sdnmpi_tpu.core.topology_db import (  # noqa: F401
+    TopologyDB,
+    Switch,
+    Link,
+    Host,
+    Port,
+)
+from sdnmpi_tpu.core.switch_fdb import SwitchFDB  # noqa: F401
+from sdnmpi_tpu.core.rank_allocation_db import RankAllocationDB  # noqa: F401

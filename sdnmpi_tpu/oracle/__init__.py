@@ -1,0 +1,1 @@
+from sdnmpi_tpu.oracle.engine import RouteOracle, TopoTensors, tensorize  # noqa: F401

@@ -1,0 +1,113 @@
+"""All-pairs shortest paths + next-hop matrices as JAX kernels.
+
+This replaces the reference's per-flow Python graph search
+(reference: sdnmpi/util/topology_db.py:59-122) with batched device
+computation over a dense ``[V, V]`` adjacency matrix:
+
+- **Distances** via multi-source BFS expressed as boolean matrix
+  multiplication: the reachability frontier ``R`` (one row per source)
+  advances with ``R @ A`` each step. Float matmul is exactly what the MXU
+  is built for, so one APSP costs ``diameter`` matmuls of ``[V, V]`` —
+  ~12 GFLOP for V=1024, microseconds on a v5e — versus 16.7M Python BFS
+  runs for a 4096-rank alltoall in the reference.
+- **Next hops** via a masked argmin over each row's neighbors: for every
+  (i, j), the lowest-indexed out-neighbor ``n`` of ``i`` minimizing
+  ``dist[n, j]``. Since indices are assigned in sorted-dpid order, the
+  lowest-index tie-break reproduces the reference's deterministic
+  ``sorted(dpids)`` neighbor ordering (topology_db.py:76,106).
+
+Shapes are static (V padded); convergence uses ``lax.while_loop`` so the
+trace is compiled once per padded size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INF = jnp.inf
+
+
+@functools.partial(jax.jit, static_argnames=("max_diameter",))
+def apsp_distances(adj: jax.Array, max_diameter: int = 0) -> jax.Array:
+    """Hop-count distance matrix ``[V, V]`` (f32, inf = unreachable).
+
+    ``adj[i, j]`` nonzero iff a directed link i -> j exists. Rows are
+    sources. Runs BFS frontier expansion as f32 matmuls under a
+    ``while_loop`` that exits as soon as no new vertex is reached, so the
+    iteration count is the graph diameter, not V. ``max_diameter`` > 0
+    additionally caps the iteration count (Config.max_diameter); paths
+    longer than the cap are reported unreachable.
+    """
+    v = adj.shape[0]
+    bound = min(v, max_diameter) if max_diameter > 0 else v
+    a = (adj > 0).astype(jnp.float32)
+    eye = jnp.eye(v, dtype=jnp.float32)
+    reached0 = eye
+    dist0 = jnp.where(eye > 0, 0.0, INF)
+
+    def cond(carry):
+        _, _, t, changed = carry
+        return changed & (t <= bound)
+
+    def body(carry):
+        reached, dist, t, _ = carry
+        # one BFS step for every source row at once; clamp to {0, 1} so
+        # values stay exact in f32 regardless of walk counts
+        grown = jnp.minimum(reached @ a + reached, 1.0)
+        newly = (grown > 0) & jnp.isinf(dist)
+        dist = jnp.where(newly, t.astype(jnp.float32), dist)
+        return grown, dist, t + 1, jnp.any(newly)
+
+    _, dist, _, _ = lax.while_loop(
+        cond, body, (reached0, dist0, jnp.int32(1), jnp.bool_(True))
+    )
+    return dist
+
+
+def _nexthop_block(adj_mask: jax.Array, dist_block: jax.Array) -> jax.Array:
+    """Next hops for a block of destination columns.
+
+    adj_mask: [V, V] bool; dist_block: [V, B] distances to B destinations.
+    Returns [V, B] int32 neighbor indices (argmin keeps lowest index on
+    ties, matching the reference's sorted-dpid determinism).
+    """
+    # scores[i, n, j] = dist[n, j] where n is an out-neighbor of i
+    scores = jnp.where(adj_mask[:, :, None], dist_block[None, :, :], INF)
+    return jnp.argmin(scores, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def apsp_next_hops(adj: jax.Array, dist: jax.Array, block: int = 0) -> jax.Array:
+    """Next-hop matrix ``[V, V]`` int32: ``next_hop[i, j]`` is the first
+    switch after ``i`` on the chosen shortest path to ``j``; ``i`` on the
+    diagonal; ``-1`` when ``j`` is unreachable from ``i``.
+
+    Destination columns are processed in blocks to bound the [V, V, B]
+    broadcast at ~256 MB regardless of V.
+    """
+    v = adj.shape[0]
+    if block == 0:
+        block = max(1, min(v, (1 << 26) // max(1, v * v)))
+        while v % block:
+            block -= 1
+    adj_mask = adj > 0
+
+    if block == v:
+        nxt = _nexthop_block(adj_mask, dist)
+    else:
+        dist_blocks = dist.T.reshape(v // block, block, v)  # [nb, B, V] rows=dst
+
+        def per_block(db):
+            return _nexthop_block(adj_mask, db.T)  # [V, B]
+
+        nxt = lax.map(per_block, dist_blocks)  # [nb, V, B]
+        nxt = jnp.moveaxis(nxt, 0, 1).reshape(v, v)
+
+    idx = jnp.arange(v, dtype=jnp.int32)
+    nxt = jnp.where(jnp.isinf(dist), -1, nxt)
+    nxt = jnp.where(idx[:, None] == idx[None, :], idx[:, None], nxt)
+    return nxt

@@ -1,0 +1,81 @@
+"""Device-side path reconstruction.
+
+Turns the next-hop matrix into concrete hop sequences — the tensor
+equivalent of the reference's ``_route_to_fdb``
+(reference: sdnmpi/util/topology_db.py:127-138) — for whole batches of
+flows at once. The hop chase is a ``lax.scan`` of gathers, vmapped over
+the flow batch; output is padded to ``max_len`` with -1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def batch_paths(
+    next_hop: jax.Array, src: jax.Array, dst: jax.Array, max_len: int
+) -> tuple[jax.Array, jax.Array]:
+    """Reconstruct switch-index paths for a batch of flows.
+
+    next_hop: [V, V] int32 (see oracle/apsp.py); src, dst: [F] int32.
+    Returns (nodes [F, max_len] int32 padded with -1, length [F] int32;
+    length 0 marks an unreachable pair).
+
+    ``max_len`` must be >= the longest path in the batch (hop count + 1);
+    a flow whose path exceeds it is indistinguishable from unreachable.
+    Callers with access to the distance matrix must size it from the
+    batch's true maximum (see RouteOracle.routes_batch).
+    """
+
+    def step(node, _):
+        # node: [F] current switch (or -1 once finished/unreachable)
+        at_dst = node == dst
+        safe = jnp.maximum(node, 0)
+        nxt = next_hop[safe, dst]
+        nxt = jnp.where(at_dst | (node < 0), -1, nxt)
+        return nxt, node
+
+    _, nodes = lax.scan(step, src, None, length=max_len)
+    nodes = nodes.T  # [F, max_len]
+    # a flow is valid iff the chase actually reached dst
+    length = jnp.sum(nodes >= 0, axis=1)
+    reached = jnp.where(
+        length > 0,
+        nodes[jnp.arange(nodes.shape[0]), jnp.maximum(length - 1, 0)] == dst,
+        False,
+    )
+    return jnp.where(reached[:, None], nodes, -1), jnp.where(reached, length, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def batch_fdb(
+    next_hop: jax.Array,
+    port: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    final_port: jax.Array,
+    max_len: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Full fdb extraction for a flow batch.
+
+    port: [V, V] int32 out-port from i toward j (-1 when no link).
+    final_port: [F] int32 port of the destination host on its edge switch.
+    Returns (hop_nodes [F, max_len], hop_ports [F, max_len], length [F]).
+    hop_ports[f, k] is the out_port at switch hop_nodes[f, k]; the last
+    valid hop's port is ``final_port[f]`` (edge switch -> host), matching
+    the reference's fdb layout (topology_db.py:127-138).
+    """
+    nodes, length = batch_paths(next_hop, src, dst, max_len)
+    f = nodes.shape[0]
+    safe = jnp.maximum(nodes, 0)
+    nxt = jnp.concatenate([safe[:, 1:], safe[:, -1:]], axis=1)
+    ports = port[safe, nxt]
+    last = jnp.maximum(length - 1, 0)
+    ports = ports.at[jnp.arange(f), last].set(final_port)
+    ports = jnp.where(nodes >= 0, ports, -1)
+    return nodes, ports, length

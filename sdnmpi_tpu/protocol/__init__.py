@@ -1,0 +1,6 @@
+from sdnmpi_tpu.protocol.announcement import (  # noqa: F401
+    Announcement,
+    AnnouncementType,
+    ANNOUNCEMENT_PACKET_LEN,
+)
+from sdnmpi_tpu.protocol.vmac import VirtualMac, is_sdn_mpi_addr  # noqa: F401

@@ -1,0 +1,131 @@
+"""Minimal OpenFlow-1.0-shaped control messages for the simulated fabric.
+
+The reference drives real switches over Ryu's OpenFlow 1.0 bindings
+(reference: sdnmpi/router.py:49-62, sdnmpi/topology.py:69-108,
+sdnmpi/process.py:61-79). This framework's southbound is a simulated switch
+fabric (control/fabric.py), so only the message *shapes* the apps exchange
+are needed: matches, actions, FlowMod, PacketOut, PortStats. The field names
+mirror OpenFlow 1.0 so the control-plane code reads like the reference's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Reserved port numbers (OpenFlow 1.0 ofp_port)
+OFPP_MAX = 0xFF00
+OFPP_IN_PORT = 0xFFF8
+OFPP_TABLE = 0xFFF9
+OFPP_NORMAL = 0xFFFA
+OFPP_FLOOD = 0xFFFB
+OFPP_ALL = 0xFFFC
+OFPP_CONTROLLER = 0xFFFD
+OFPP_LOCAL = 0xFFFE
+OFPP_NONE = 0xFFFF
+
+OFP_NO_BUFFER = 0xFFFFFFFF
+
+# Flow mod commands
+OFPFC_ADD = 0
+OFPFC_DELETE = 3
+
+ETH_TYPE_IP = 0x0800
+ETH_TYPE_LLDP = 0x88CC
+IPPROTO_UDP = 17
+
+
+@dataclasses.dataclass(frozen=True)
+class Match:
+    """Subset of ofp_match used by the apps; ``None`` fields are wildcards."""
+
+    in_port: Optional[int] = None
+    dl_src: Optional[str] = None
+    dl_dst: Optional[str] = None
+    dl_type: Optional[int] = None
+    nw_proto: Optional[int] = None
+    tp_dst: Optional[int] = None
+
+    def matches(self, pkt: "Packet", in_port: int) -> bool:
+        if self.in_port is not None and in_port != self.in_port:
+            return False
+        if self.dl_src is not None and pkt.eth_src != self.dl_src:
+            return False
+        if self.dl_dst is not None and pkt.eth_dst != self.dl_dst:
+            return False
+        if self.dl_type is not None and pkt.eth_type != self.dl_type:
+            return False
+        if self.nw_proto is not None and pkt.ip_proto != self.nw_proto:
+            return False
+        if self.tp_dst is not None and pkt.udp_dst != self.tp_dst:
+            return False
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class ActionOutput:
+    port: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ActionSetDlDst:
+    """Rewrite destination MAC — used on the last hop of an MPI route to
+    translate the virtual MAC back to the real host MAC
+    (reference: sdnmpi/router.py:98-102)."""
+
+    mac: str
+
+
+Action = ActionOutput | ActionSetDlDst
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowMod:
+    match: Match
+    actions: tuple[Action, ...]
+    priority: int
+    command: int = OFPFC_ADD
+    idle_timeout: int = 0
+    hard_timeout: int = 0
+    cookie: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PacketOut:
+    data: "Packet"
+    actions: tuple[Action, ...]
+    in_port: int = OFPP_NONE
+    buffer_id: int = OFP_NO_BUFFER
+
+
+@dataclasses.dataclass(frozen=True)
+class Packet:
+    """A parsed-enough Ethernet frame for the control plane.
+
+    The reference parses real frames with ryu.lib.packet
+    (reference: sdnmpi/router.py:130-133, process.py:84-89); the simulated
+    fabric passes structured frames instead, carrying only the header fields
+    the apps inspect plus an opaque payload.
+    """
+
+    eth_src: str
+    eth_dst: str
+    eth_type: int = ETH_TYPE_IP
+    ip_proto: Optional[int] = None
+    udp_dst: Optional[int] = None
+    payload: bytes = b""
+
+    def with_dst(self, mac: str) -> "Packet":
+        return dataclasses.replace(self, eth_dst=mac)
+
+
+@dataclasses.dataclass(frozen=True)
+class PortStatsEntry:
+    """One port's cumulative counters (ofp_port_stats subset the Monitor
+    reads, reference: sdnmpi/monitor.py:67-94)."""
+
+    port_no: int
+    rx_packets: int
+    rx_bytes: int
+    tx_packets: int
+    tx_bytes: int

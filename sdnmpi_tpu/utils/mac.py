@@ -1,0 +1,42 @@
+"""MAC address helpers.
+
+The reference leans on ``ryu.lib.mac.haddr_to_bin`` and ad-hoc parsing
+(reference: sdnmpi/util/topology_db.py:124-125, sdnmpi/router.py:162-178).
+These are the dependency-free equivalents.
+"""
+
+from __future__ import annotations
+
+BROADCAST_MAC = "ff:ff:ff:ff:ff:ff"
+IPV6_MCAST_PREFIX = "33:33"
+
+
+def mac_to_int(mac: str) -> int:
+    """Parse ``"02:00:00:00:00:01"`` -> 0x020000000001."""
+    return int(mac.replace(":", ""), 16)
+
+
+def int_to_mac(value: int) -> str:
+    if not 0 <= value < 1 << 48:
+        raise ValueError(f"MAC value out of range: {value:#x}")
+    raw = f"{value:012x}"
+    return ":".join(raw[i : i + 2] for i in range(0, 12, 2))
+
+
+def mac_to_bytes(mac: str) -> bytes:
+    return bytes.fromhex(mac.replace(":", ""))
+
+
+def bytes_to_mac(raw: bytes) -> str:
+    if len(raw) != 6:
+        raise ValueError(f"MAC must be 6 bytes, got {len(raw)}")
+    return ":".join(f"{b:02x}" for b in raw)
+
+
+def is_broadcast(mac: str) -> bool:
+    return mac.lower() == BROADCAST_MAC
+
+
+def is_ipv6_multicast(mac: str) -> bool:
+    """IPv6 multicast MACs start with 33:33 (reference: router.py:142)."""
+    return mac.lower().startswith(IPV6_MCAST_PREFIX)

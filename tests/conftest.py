@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on the JAX CPU backend with 8 virtual devices so that the
+multi-chip sharding paths (parallel/) are exercised without TPU hardware.
+The env vars must be set before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,150 @@
+"""Direct unit tests for the JAX APSP / path-extraction kernels."""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.oracle.paths import batch_fdb, batch_paths
+from tests.topo_fixtures import diamond
+
+
+def py_apsp(adj: np.ndarray) -> np.ndarray:
+    """Reference BFS APSP in plain numpy (independent of the kernels)."""
+    v = adj.shape[0]
+    dist = np.full((v, v), np.inf)
+    for s in range(v):
+        dist[s, s] = 0
+        frontier = [s]
+        d = 0
+        while frontier:
+            d += 1
+            nxt = []
+            for u in frontier:
+                for w in np.nonzero(adj[u])[0]:
+                    if not np.isfinite(dist[s, w]):
+                        dist[s, w] = d
+                        nxt.append(w)
+            frontier = nxt
+    return dist
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("v,p", [(8, 0.3), (16, 0.15), (32, 0.08)])
+def test_apsp_matches_python_bfs(seed, v, p):
+    rng = np.random.default_rng(seed)
+    adj = (rng.random((v, v)) < p).astype(np.float32)
+    np.fill_diagonal(adj, 0)
+    dist = np.asarray(apsp_distances(adj))
+    expected = py_apsp(adj)
+    np.testing.assert_array_equal(dist, expected)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_next_hops_are_consistent(seed):
+    rng = np.random.default_rng(seed)
+    v = 16
+    adj = (rng.random((v, v)) < 0.2).astype(np.float32)
+    np.fill_diagonal(adj, 0)
+    dist = apsp_distances(adj)
+    nxt = np.asarray(apsp_next_hops(adj, dist))
+    d = np.asarray(dist)
+    for i in range(v):
+        for j in range(v):
+            if i == j:
+                assert nxt[i, j] == i
+            elif np.isfinite(d[i, j]):
+                n = nxt[i, j]
+                # next hop must be a real neighbor strictly closer to j...
+                assert adj[i, n] > 0
+                assert d[n, j] == d[i, j] - 1
+                # ...and the lowest-indexed such neighbor (determinism)
+                for m in range(n):
+                    if adj[i, m] > 0:
+                        assert d[m, j] > d[n, j]
+            else:
+                assert nxt[i, j] == -1
+
+
+def test_next_hop_blocking_invariance():
+    rng = np.random.default_rng(7)
+    v = 24
+    adj = (rng.random((v, v)) < 0.15).astype(np.float32)
+    np.fill_diagonal(adj, 0)
+    dist = apsp_distances(adj)
+    full = np.asarray(apsp_next_hops(adj, dist, block=24))
+    blocked = np.asarray(apsp_next_hops(adj, dist, block=8))
+    np.testing.assert_array_equal(full, blocked)
+
+
+class TestBatchPaths:
+    def setup_method(self):
+        self.db = diamond(backend="jax")
+        self.t = tensorize(self.db)
+        self.dist = apsp_distances(self.t.adj)
+        self.next = apsp_next_hops(self.t.adj, self.dist)
+
+    def test_paths(self):
+        idx = self.t.index
+        src = np.array([idx[1], idx[1], idx[3], idx[2]], dtype=np.int32)
+        dst = np.array([idx[4], idx[1], idx[4], idx[3]], dtype=np.int32)
+        nodes, length = batch_paths(self.next, src, dst, max_len=6)
+        nodes, length = np.asarray(nodes), np.asarray(length)
+        # 1 -> 4 via lowest-dpid tie-break: 1, 2, 4
+        assert nodes[0, :3].tolist() == [idx[1], idx[2], idx[4]]
+        assert length[0] == 3
+        # self path
+        assert nodes[1, 0] == idx[1] and length[1] == 1
+        # 3 -> 4 direct
+        assert nodes[2, :2].tolist() == [idx[3], idx[4]] and length[2] == 2
+        # 2 -> 3 must go through 1 or 4 (both dist 2): lowest index = 1
+        assert nodes[3, :3].tolist() == [idx[2], idx[1], idx[3]]
+
+    def test_unreachable_marked(self):
+        # cut all of switch 1's outgoing links
+        del self.db.links[1]
+        self.db._version += 1
+        t = tensorize(self.db)
+        dist = apsp_distances(t.adj)
+        nxt = apsp_next_hops(t.adj, dist)
+        src = np.array([t.index[1]], dtype=np.int32)
+        dst = np.array([t.index[4]], dtype=np.int32)
+        nodes, length = batch_paths(nxt, src, dst, max_len=6)
+        assert np.asarray(length)[0] == 0
+        assert (np.asarray(nodes)[0] == -1).all()
+
+    def test_fdb_ports(self):
+        idx = self.t.index
+        src = np.array([idx[1]], dtype=np.int32)
+        dst = np.array([idx[4]], dtype=np.int32)
+        final_port = np.array([1], dtype=np.int32)  # host port on switch 4
+        nodes, ports, length = batch_fdb(
+            self.next, self.t.port, src, dst, final_port, max_len=6
+        )
+        # golden: [(1, 2), (2, 3), (4, 1)] — same as TopologyDB.find_route
+        assert np.asarray(length)[0] == 3
+        assert np.asarray(ports)[0, :3].tolist() == [2, 3, 1]
+
+
+def test_batch_fdb_matches_topology_db():
+    """End-to-end: device batch extraction == host find_route, every pair."""
+    db = diamond(backend="jax")
+    t = tensorize(db)
+    dist = apsp_distances(t.adj)
+    nxt = apsp_next_hops(t.adj, dist)
+
+    macs = sorted(db.hosts)
+    pairs = [(a, b) for a in macs for b in macs if a != b]
+    src = np.array([t.index[db.hosts[a].port.dpid] for a, _ in pairs], dtype=np.int32)
+    dst = np.array([t.index[db.hosts[b].port.dpid] for _, b in pairs], dtype=np.int32)
+    final = np.array([db.hosts[b].port.port_no for _, b in pairs], dtype=np.int32)
+
+    nodes, ports, length = batch_fdb(nxt, t.port, src, dst, final, max_len=8)
+    nodes, ports, length = map(np.asarray, (nodes, ports, length))
+
+    for f, (a, b) in enumerate(pairs):
+        expected = db.find_route(a, b)
+        got = [
+            (int(t.dpids[nodes[f, k]]), int(ports[f, k])) for k in range(length[f])
+        ]
+        assert got == expected, f"{a}->{b}: {got} != {expected}"

@@ -1,0 +1,83 @@
+"""Wire-protocol codec tests (announcement sideband, virtual MAC)."""
+
+import pytest
+
+from sdnmpi_tpu.protocol.announcement import (
+    ANNOUNCEMENT_PACKET_LEN,
+    Announcement,
+    AnnouncementType,
+)
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac, is_sdn_mpi_addr
+from sdnmpi_tpu.utils.mac import (
+    bytes_to_mac,
+    int_to_mac,
+    mac_to_bytes,
+    mac_to_int,
+)
+
+
+class TestAnnouncement:
+    def test_packet_len_matches_reference_abi(self):
+        # construct Struct of SLInt32 type + union(SLInt32 rank) == 8 bytes
+        # (reference: sdnmpi/protocol/announcement.py:9-18)
+        assert ANNOUNCEMENT_PACKET_LEN == 8
+
+    def test_roundtrip(self):
+        for ann in (
+            Announcement(AnnouncementType.LAUNCH, 0),
+            Announcement(AnnouncementType.LAUNCH, 4095),
+            Announcement(AnnouncementType.EXIT, 17),
+        ):
+            assert Announcement.decode(ann.encode()) == ann
+
+    def test_wire_layout_little_endian(self):
+        raw = Announcement(AnnouncementType.EXIT, 258).encode()
+        assert raw == b"\x01\x00\x00\x00\x02\x01\x00\x00"
+
+    def test_decode_rejects_short_packet(self):
+        with pytest.raises(ValueError):
+            Announcement.decode(b"\x00\x00")
+
+    def test_decode_ignores_trailing_bytes(self):
+        ann = Announcement(AnnouncementType.LAUNCH, 3)
+        assert Announcement.decode(ann.encode() + b"pad") == ann
+
+
+class TestVirtualMac:
+    def test_roundtrip(self):
+        vm = VirtualMac(CollectiveType.ALLTOALL, src_rank=300, dst_rank=4095)
+        decoded = VirtualMac.decode(vm.encode())
+        assert decoded == vm
+
+    def test_wire_layout(self):
+        # byte0 = (coll_type << 2) | 0x02; ranks little-endian int16 at
+        # bytes 2:4 and 4:6 (reference: sdnmpi/router.py:175-178)
+        mac = VirtualMac(3, 0x0102, 0x0304).encode()
+        assert mac == "0e:00:02:01:04:03"
+
+    def test_locally_administered_bit(self):
+        assert is_sdn_mpi_addr(VirtualMac(0, 0, 0).encode())
+        assert is_sdn_mpi_addr("02:00:00:00:00:01")
+        assert not is_sdn_mpi_addr("00:11:22:33:44:55")
+
+    def test_decode_rejects_plain_mac(self):
+        with pytest.raises(ValueError):
+            VirtualMac.decode("00:11:22:33:44:55")
+
+    def test_negative_ranks_roundtrip(self):
+        vm = VirtualMac(0, -1, -2)
+        assert VirtualMac.decode(vm.encode()) == vm
+
+
+class TestMacHelpers:
+    def test_roundtrips(self):
+        mac = "02:00:00:00:00:2a"
+        assert int_to_mac(mac_to_int(mac)) == mac
+        assert bytes_to_mac(mac_to_bytes(mac)) == mac
+
+    def test_mac_to_int(self):
+        assert mac_to_int("02:00:00:00:00:01") == 0x020000000001
+
+    def test_int_to_mac_range(self):
+        with pytest.raises(ValueError):
+            int_to_mac(1 << 48)

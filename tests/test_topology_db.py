@@ -1,0 +1,170 @@
+"""TopologyDB golden tests.
+
+The expectations on the diamond topology are the reference's own test
+vectors (reference: tests/test_topologydb.py:63-109), parametrized over
+both routing backends — the pure-Python BFS and the JAX oracle must agree
+bit-for-bit.
+"""
+
+import pytest
+
+from sdnmpi_tpu.core.switch_fdb import SwitchFDB
+from sdnmpi_tpu.core.rank_allocation_db import RankAllocationDB
+from sdnmpi_tpu.protocol.openflow import OFPP_LOCAL
+from tests.topo_fixtures import MAC1, MAC2, MAC3, MAC4, diamond, host_mac, line
+
+BACKENDS = ["py", "jax"]
+
+
+@pytest.fixture(params=BACKENDS)
+def topo(request):
+    return diamond(backend=request.param)
+
+
+class TestFindRoute:
+    def test_same_host(self, topo):
+        # (reference: tests/test_topologydb.py:63-71)
+        assert topo.find_route(MAC1, MAC1) == [(1, 1)]
+        assert topo.find_route(MAC2, MAC2) == [(2, 1)]
+        assert topo.find_route(MAC3, MAC3) == [(3, 1)]
+        assert topo.find_route(MAC4, MAC4) == [(4, 1)]
+
+    def test_unreachable(self, topo):
+        # deleting switch 1's outgoing links leaves the graph asymmetric;
+        # nothing is reachable *from* host 1
+        # (reference: tests/test_topologydb.py:73-80)
+        del topo.links[1]
+        topo._version += 1
+        assert topo.find_route(MAC1, MAC2) == []
+        assert topo.find_route(MAC1, MAC3) == []
+        assert topo.find_route(MAC1, MAC4) == []
+        # ...but the reverse direction still works (2 -> 1 link remains)
+        assert topo.find_route(MAC2, MAC1) == [(2, 2), (1, 1)]
+
+    def test_one_hop(self, topo):
+        # (reference: tests/test_topologydb.py:82-90)
+        assert topo.find_route(MAC1, MAC2) == [(1, 2), (2, 1)]
+        assert topo.find_route(MAC1, MAC3) == [(1, 3), (3, 1)]
+        assert topo.find_route(MAC2, MAC4) == [(2, 3), (4, 1)]
+        assert topo.find_route(MAC3, MAC4) == [(3, 2), (4, 1)]
+
+    def test_two_hop_deterministic_tiebreak(self, topo):
+        # 1->4 has two shortest routes (via 2 or via 3); lowest dpid wins
+        assert topo.find_route(MAC1, MAC4) == [(1, 2), (2, 3), (4, 1)]
+
+    def test_unknown_mac(self, topo):
+        assert topo.find_route(MAC1, "02:00:00:00:00:99") == []
+        assert topo.find_route("02:00:00:00:00:99", MAC1) == []
+
+    def test_switch_local_endpoints(self, topo):
+        # a MAC that parses to a known dpid routes to the switch's local
+        # port (reference: sdnmpi/util/topology_db.py:143-166,132-134)
+        switch2_mac = "00:00:00:00:00:02"
+        fdb = topo.find_route(MAC1, switch2_mac)
+        assert fdb == [(1, 2), (2, OFPP_LOCAL)]
+        fdb = topo.find_route(switch2_mac, MAC1)
+        assert fdb == [(2, 2), (1, 1)]
+
+
+class TestFindMultipleRoutes:
+    def test_diamond_ecmp(self, topo):
+        # (reference: tests/test_topologydb.py:92-100)
+        routes = topo.find_route(MAC1, MAC4, True)
+        route1 = [(1, 2), (2, 3), (4, 1)]
+        route2 = [(1, 3), (3, 2), (4, 1)]
+        assert sorted(routes) == sorted([route1, route2])
+
+        routes = topo.find_route(MAC3, MAC4, True)
+        assert sorted(routes) == [[(3, 2), (4, 1)]]
+
+    def test_unreachable(self, topo):
+        # (reference: tests/test_topologydb.py:102-109)
+        del topo.links[1]
+        topo._version += 1
+        assert topo.find_route(MAC1, MAC2, True) == []
+        assert topo.find_route(MAC1, MAC3, True) == []
+        assert topo.find_route(MAC1, MAC4, True) == []
+
+
+class TestBatchedRoutes:
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_batch_matches_single(self, backend):
+        topo = diamond(backend=backend)
+        macs = [MAC1, MAC2, MAC3, MAC4]
+        pairs = [(a, b) for a in macs for b in macs]
+        batch = topo.find_routes_batch(pairs)
+        singles = [topo.find_route(a, b) for a, b in pairs]
+        assert batch == singles
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_line_topology(self, backend):
+        topo = line(6, backend=backend)
+        fdb = topo.find_route(host_mac(1), host_mac(6))
+        assert fdb == [(1, 3), (2, 3), (3, 3), (4, 3), (5, 3), (6, 1)]
+
+
+class TestBackendEquivalence:
+    def test_random_graphs(self):
+        import random
+
+        rng = random.Random(42)
+        from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch, TopologyDB
+
+        for trial in range(12):
+            n = rng.randint(2, 12)
+            dbs = [TopologyDB(backend=b) for b in BACKENDS]
+            for db in dbs:
+                for dpid in range(1, n + 1):
+                    db.add_switch(Switch.make(dpid))
+                    db.add_host(Host(host_mac(dpid), Port(dpid, 1)))
+            # random directed edge set, port = 100 + neighbor dpid
+            for a in range(1, n + 1):
+                for b in range(1, n + 1):
+                    if a != b and rng.random() < 0.3:
+                        for db in dbs:
+                            db.add_link(Link(Port(a, 100 + b), Port(b, 100 + a)))
+            for a in range(1, n + 1):
+                for b in range(1, n + 1):
+                    got = [
+                        db.find_route(host_mac(a), host_mac(b)) for db in dbs
+                    ]
+                    assert got[0] == got[1], (
+                        f"trial {trial}: backends disagree on {a}->{b}: {got}"
+                    )
+                    multi = [
+                        db.find_route(host_mac(a), host_mac(b), True) for db in dbs
+                    ]
+                    assert sorted(multi[0]) == sorted(multi[1])
+
+
+class TestStores:
+    def test_to_dict_snapshot(self):
+        topo = diamond()
+        snap = topo.to_dict()
+        assert len(snap["switches"]) == 4
+        assert len(snap["links"]) == 8
+        assert len(snap["hosts"]) == 4
+
+    def test_switch_fdb(self):
+        fdb = SwitchFDB()
+        fdb.update(1, MAC1, MAC2, 2)
+        assert fdb.exists(1, MAC1, MAC2)
+        assert not fdb.exists(1, MAC2, MAC1)
+        assert fdb.to_dict() == {"1": {f"{MAC1} {MAC2}": 2}}
+        assert fdb.remove(1, MAC1, MAC2)
+        assert not fdb.exists(1, MAC1, MAC2)
+        assert not fdb.remove(1, MAC1, MAC2)
+
+    def test_rank_allocation_db(self):
+        db = RankAllocationDB()
+        db.add_process(0, MAC1)
+        db.add_process(1, MAC2)
+        assert db.get_mac(0) == MAC1
+        assert db.ranks() == [0, 1]
+        db.delete_process(0)
+        assert db.get_mac(0) is None
+        # reference-spelling alias (sdnmpi/util/rank_allocation_db.py:9)
+        db.delete_prcess(1)
+        assert len(db) == 0
+        db.add_process(5, MAC3)
+        assert db.to_dict() == {"5": MAC3}

@@ -1,0 +1,57 @@
+"""Shared topology fixtures.
+
+The diamond fixture reproduces the reference test topology byte-for-byte
+(reference: tests/test_topologydb.py:14-61): four switches 1-2-4 / 1-3-4
+with bidirectional directed link entries, one host per switch on port 1,
+inter-switch links on ports 2/3.
+"""
+
+from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch, TopologyDB
+
+MAC1 = "02:00:00:00:00:01"
+MAC2 = "02:00:00:00:00:02"
+MAC3 = "02:00:00:00:00:03"
+MAC4 = "02:00:00:00:00:04"
+
+
+def diamond(backend: str = "py") -> TopologyDB:
+    db = TopologyDB(backend=backend)
+
+    p = {
+        (dpid, port_no): Port(dpid, port_no)
+        for dpid in (1, 2, 3, 4)
+        for port_no in (1, 2, 3)
+    }
+
+    db.links = {
+        1: {2: Link(p[1, 2], p[2, 2]), 3: Link(p[1, 3], p[3, 3])},
+        2: {1: Link(p[2, 2], p[1, 2]), 4: Link(p[2, 3], p[4, 2])},
+        3: {1: Link(p[3, 3], p[1, 3]), 4: Link(p[3, 2], p[4, 3])},
+        4: {2: Link(p[4, 2], p[2, 3]), 3: Link(p[4, 3], p[3, 2])},
+    }
+    db.hosts = {
+        MAC1: Host(MAC1, p[1, 1]),
+        MAC2: Host(MAC2, p[2, 1]),
+        MAC3: Host(MAC3, p[3, 1]),
+        MAC4: Host(MAC4, p[4, 1]),
+    }
+    db.switches = {dpid: Switch.make(dpid) for dpid in (1, 2, 3, 4)}
+    return db
+
+
+def line(n: int, backend: str = "py") -> TopologyDB:
+    """Linear topology: switches 1..n chained, host i on switch i port 1."""
+    db = TopologyDB(backend=backend)
+    for dpid in range(1, n + 1):
+        db.add_switch(Switch.make(dpid))
+        mac = f"02:00:00:00:00:{dpid:02x}"
+        db.add_host(Host(mac, Port(dpid, 1)))
+    for a in range(1, n):
+        b = a + 1
+        db.add_link(Link(Port(a, 3), Port(b, 2)))
+        db.add_link(Link(Port(b, 2), Port(a, 3)))
+    return db
+
+
+def host_mac(i: int) -> str:
+    return f"02:00:00:00:00:{i:02x}"
