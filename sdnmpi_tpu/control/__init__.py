@@ -1,0 +1,7 @@
+from sdnmpi_tpu.control.bus import EventBus  # noqa: F401
+from sdnmpi_tpu.control.fabric import Fabric, SimHost, SimSwitch  # noqa: F401
+from sdnmpi_tpu.control.router import Router  # noqa: F401
+from sdnmpi_tpu.control.topology_manager import TopologyManager  # noqa: F401
+from sdnmpi_tpu.control.process_manager import ProcessManager  # noqa: F401
+from sdnmpi_tpu.control.monitor import Monitor  # noqa: F401
+from sdnmpi_tpu.control.controller import Controller  # noqa: F401

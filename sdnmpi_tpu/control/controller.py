@@ -1,0 +1,43 @@
+"""Controller composition root.
+
+The reference composes its apps through Ryu's ``_CONTEXTS`` dependency
+injection, with ``RPCInterface`` as the transitive root
+(reference: sdnmpi/rpc_interface.py:19-25; SURVEY §3.1). Here composition
+is explicit: one ``Controller`` wires the bus, the four apps, and the
+southbound together, in a fixed deterministic order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
+from sdnmpi_tpu.control.bus import EventBus
+from sdnmpi_tpu.control.monitor import Monitor
+from sdnmpi_tpu.control.process_manager import ProcessManager
+from sdnmpi_tpu.control.router import Router
+from sdnmpi_tpu.control.topology_manager import TopologyManager
+
+
+class Controller:
+    def __init__(
+        self,
+        southbound,
+        config: Config = DEFAULT_CONFIG,
+    ) -> None:
+        self.config = config
+        self.bus = EventBus()
+        self.southbound = southbound
+
+        # Subscription order fixes packet-in handling order; the reference's
+        # equivalent order is Ryu's app instantiation order (SURVEY §3.1).
+        self.topology_manager = TopologyManager(self.bus, southbound, config)
+        self.process_manager = ProcessManager(self.bus, southbound, config)
+        self.router = Router(self.bus, southbound, config)
+        self.monitor: Optional[Monitor] = (
+            Monitor(self.bus, southbound, config) if config.enable_monitor else None
+        )
+
+    def attach(self) -> None:
+        """Connect the southbound fabric and replay discovery."""
+        self.southbound.connect(self.bus)

@@ -1,0 +1,299 @@
+"""Simulated switch fabric — the southbound the reference never had tests for.
+
+The reference drives real OpenFlow 1.0 switches and was integration-tested
+only by hand against Mininet (SURVEY §4); its unit tests bypass the network
+entirely. This module provides the missing layer: an in-process fabric of
+switches with priority-ordered flow tables, links, hosts, and per-port
+counters, speaking the message shapes in protocol/openflow.py. The apps
+drive it exactly like the reference drives datapaths (FlowMod / PacketOut /
+PortStats / packet-in), so the whole control plane is testable end to end —
+announcement in, flows installed, packets forwarded, counters ticking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from sdnmpi_tpu.control.events import (
+    EventDatapathDown,
+    EventDatapathUp,
+    EventHostAdd,
+    EventLinkAdd,
+    EventLinkDelete,
+    EventPacketIn,
+    EventSwitchEnter,
+    EventSwitchLeave,
+    EventTopologyChanged,
+)
+from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
+from sdnmpi_tpu.protocol import openflow as of
+
+log = logging.getLogger(__name__)
+
+_MAX_HOPS = 64  # forwarding-loop guard for the simulation
+
+
+@dataclasses.dataclass
+class SimPort:
+    port_no: int
+    #: ("switch", dpid, port_no) | ("host", mac) | None
+    peer: Optional[tuple] = None
+    rx_packets: int = 0
+    rx_bytes: int = 0
+    tx_packets: int = 0
+    tx_bytes: int = 0
+
+
+@dataclasses.dataclass
+class _FlowEntry:
+    priority: int
+    match: of.Match
+    actions: tuple[of.Action, ...]
+    seq: int  # insertion order tie-break
+
+
+class SimSwitch:
+    def __init__(self, fabric: "Fabric", dpid: int) -> None:
+        self.fabric = fabric
+        self.dpid = dpid
+        self.ports: dict[int, SimPort] = {}
+        self.flow_table: list[_FlowEntry] = []
+        self.local_delivered: list[of.Packet] = []  # OFPP_LOCAL sink
+        self._seq = 0
+
+    def port(self, port_no: int) -> SimPort:
+        return self.ports.setdefault(port_no, SimPort(port_no))
+
+    # -- flow table -------------------------------------------------------
+
+    def flow_mod(self, mod: of.FlowMod) -> None:
+        if mod.command == of.OFPFC_ADD:
+            self._seq += 1
+            self.flow_table.append(
+                _FlowEntry(mod.priority, mod.match, mod.actions, self._seq)
+            )
+            # highest priority first; earlier install wins ties
+            self.flow_table.sort(key=lambda e: (-e.priority, e.seq))
+        elif mod.command == of.OFPFC_DELETE:
+            self.flow_table = [e for e in self.flow_table if e.match != mod.match]
+        else:
+            raise ValueError(f"unsupported flow_mod command {mod.command}")
+
+    def lookup(self, pkt: of.Packet, in_port: int) -> Optional[_FlowEntry]:
+        for entry in self.flow_table:
+            if entry.match.matches(pkt, in_port):
+                return entry
+        return None
+
+    # -- data path --------------------------------------------------------
+
+    def receive(self, pkt: of.Packet, in_port: int, hops: int) -> None:
+        port = self.port(in_port)
+        port.rx_packets += 1
+        port.rx_bytes += _pkt_len(pkt)
+
+        entry = self.lookup(pkt, in_port)
+        if entry is None:
+            # table miss -> controller (the reference runs ryu-manager with
+            # --noexplicit-drop so unmatched packets reach the apps,
+            # run_router.sh:2)
+            self.fabric.packet_in(self.dpid, in_port, pkt)
+            return
+        self.apply_actions(entry.actions, pkt, in_port, hops)
+
+    def apply_actions(
+        self,
+        actions: tuple[of.Action, ...],
+        pkt: of.Packet,
+        in_port: int,
+        hops: int,
+    ) -> None:
+        for action in actions:
+            if isinstance(action, of.ActionSetDlDst):
+                pkt = pkt.with_dst(action.mac)
+            elif isinstance(action, of.ActionOutput):
+                self._output(action.port, pkt, in_port, hops)
+            else:
+                raise ValueError(f"unsupported action {action!r}")
+        # empty action list == drop (used by the IPv6-multicast drop rule,
+        # reference: sdnmpi/topology.py:88-92)
+
+    def _output(self, port_no: int, pkt: of.Packet, in_port: int, hops: int) -> None:
+        if port_no == of.OFPP_CONTROLLER:
+            self.fabric.packet_in(self.dpid, in_port, pkt)
+            return
+        if port_no == of.OFPP_LOCAL:
+            self.local_delivered.append(pkt)
+            return
+        if port_no == of.OFPP_IN_PORT:
+            port_no = in_port
+        port = self.ports.get(port_no)
+        if port is None or port.peer is None:
+            log.debug("dpid %s: output to dead port %s dropped", self.dpid, port_no)
+            return
+        port.tx_packets += 1
+        port.tx_bytes += _pkt_len(pkt)
+        self.fabric.transmit(port.peer, pkt, hops)
+
+    def port_stats(self) -> list[of.PortStatsEntry]:
+        return [
+            of.PortStatsEntry(
+                p.port_no, p.rx_packets, p.rx_bytes, p.tx_packets, p.tx_bytes
+            )
+            for p in sorted(self.ports.values(), key=lambda p: p.port_no)
+        ]
+
+    def to_entity(self) -> Switch:
+        return Switch.make(
+            self.dpid, [Port(self.dpid, p.port_no) for p in self.ports.values()]
+        )
+
+
+class SimHost:
+    def __init__(self, fabric: "Fabric", mac: str, dpid: int, port_no: int) -> None:
+        self.fabric = fabric
+        self.mac = mac
+        self.dpid = dpid
+        self.port_no = port_no
+        self.received: list[of.Packet] = []
+
+    def send(self, pkt: of.Packet) -> None:
+        self.fabric.switches[self.dpid].receive(pkt, self.port_no, hops=0)
+
+    def to_entity(self) -> Host:
+        return Host(self.mac, Port(self.dpid, self.port_no))
+
+
+class Fabric:
+    """Container for the simulated network; owns discovery announcements."""
+
+    def __init__(self) -> None:
+        self.switches: dict[int, SimSwitch] = {}
+        self.hosts: dict[str, SimHost] = {}
+        self.links: list[tuple[int, int, int, int]] = []  # (a, pa, b, pb)
+        self.bus = None  # set by connect()
+
+    # -- construction -----------------------------------------------------
+
+    def add_switch(self, dpid: int) -> SimSwitch:
+        sw = SimSwitch(self, dpid)
+        self.switches[dpid] = sw
+        if self.bus is not None:
+            self.bus.publish(EventDatapathUp(dpid))
+            self.bus.publish(EventSwitchEnter(sw.to_entity()))
+        return sw
+
+    def add_link(self, a: int, port_a: int, b: int, port_b: int) -> None:
+        """Bidirectional link a:port_a <-> b:port_b (LLDP discovery reports
+        both directed halves, as the reference's TopologyDB stores them)."""
+        self.switches[a].port(port_a).peer = ("switch", b, port_b)
+        self.switches[b].port(port_b).peer = ("switch", a, port_a)
+        self.links.append((a, port_a, b, port_b))
+        if self.bus is not None:
+            for link in self._link_entities(a, port_a, b, port_b):
+                self.bus.publish(EventLinkAdd(link))
+
+    def add_host(self, mac: str, dpid: int, port_no: int) -> SimHost:
+        host = SimHost(self, mac, dpid, port_no)
+        self.hosts[mac] = host
+        self.switches[dpid].port(port_no).peer = ("host", mac)
+        if self.bus is not None:
+            self.bus.publish(EventHostAdd(host.to_entity()))
+        return host
+
+    @staticmethod
+    def _link_entities(a: int, pa: int, b: int, pb: int) -> tuple[Link, Link]:
+        return (
+            Link(Port(a, pa), Port(b, pb)),
+            Link(Port(b, pb), Port(a, pa)),
+        )
+
+    # -- failure injection ------------------------------------------------
+
+    def remove_link(self, a: int, port_a: int, b: int, port_b: int) -> None:
+        self.links.remove((a, port_a, b, port_b))
+        self.switches[a].port(port_a).peer = None
+        self.switches[b].port(port_b).peer = None
+        if self.bus is not None:
+            for link in self._link_entities(a, port_a, b, port_b):
+                self.bus.publish(EventLinkDelete(link))
+            # one coalesced signal after both directed halves, so flow
+            # revalidation runs once per topological change
+            self.bus.publish(EventTopologyChanged())
+
+    def remove_switch(self, dpid: int) -> None:
+        sw = self.switches.pop(dpid)
+        # datapath-down first so flow cleanup never targets the dead switch
+        if self.bus is not None:
+            self.bus.publish(EventDatapathDown(dpid))
+        for a, pa, b, pb in [l for l in self.links if dpid in (l[0], l[2])]:
+            self.links.remove((a, pa, b, pb))
+            other, other_port = (b, pb) if a == dpid else (a, pa)
+            if other in self.switches:
+                self.switches[other].port(other_port).peer = None
+            if self.bus is not None:
+                for link in self._link_entities(a, pa, b, pb):
+                    self.bus.publish(EventLinkDelete(link))
+        if self.bus is not None:
+            self.bus.publish(EventSwitchLeave(sw.to_entity()))
+            self.bus.publish(EventTopologyChanged())
+
+    # -- controller attachment --------------------------------------------
+
+    def connect(self, bus) -> None:
+        """Attach the control plane and replay discovery for the current
+        network, the way Ryu's LLDP discovery populates a fresh controller
+        (--observe-links, reference: run_router.sh:2)."""
+        self.bus = bus
+        for dpid, sw in sorted(self.switches.items()):
+            bus.publish(EventDatapathUp(dpid))
+            bus.publish(EventSwitchEnter(sw.to_entity()))
+        for a, pa, b, pb in self.links:
+            for link in self._link_entities(a, pa, b, pb):
+                bus.publish(EventLinkAdd(link))
+        for host in self.hosts.values():
+            bus.publish(EventHostAdd(host.to_entity()))
+
+    # -- southbound API used by the apps ----------------------------------
+
+    def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
+        sw = self.switches.get(dpid)
+        if sw is None:  # datapath died between event and flow_mod
+            log.debug("flow_mod to unknown dpid %s dropped", dpid)
+            return
+        sw.flow_mod(mod)
+
+    def packet_out(self, dpid: int, out: of.PacketOut) -> None:
+        self.switches[dpid].apply_actions(out.actions, out.data, out.in_port, hops=0)
+
+    def port_stats(self, dpid: int) -> list[of.PortStatsEntry]:
+        return self.switches[dpid].port_stats()
+
+    def connected_dpids(self) -> list[int]:
+        return sorted(self.switches)
+
+    # -- internal transit -------------------------------------------------
+
+    def packet_in(self, dpid: int, in_port: int, pkt: of.Packet) -> None:
+        if self.bus is not None:
+            self.bus.publish(EventPacketIn(dpid, in_port, pkt, of.OFP_NO_BUFFER))
+
+    def transmit(self, peer: tuple, pkt: of.Packet, hops: int) -> None:
+        if hops >= _MAX_HOPS:
+            log.warning("dropping packet after %d hops (loop?)", hops)
+            return
+        if peer[0] == "host":
+            host = self.hosts.get(peer[1])
+            if host is not None:
+                host.received.append(pkt)
+        else:
+            _, dpid, port_no = peer
+            sw = self.switches.get(dpid)
+            if sw is not None:
+                sw.receive(pkt, port_no, hops + 1)
+
+
+def _pkt_len(pkt: of.Packet) -> int:
+    return 14 + len(pkt.payload)  # ethernet header + payload

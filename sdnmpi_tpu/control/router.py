@@ -1,0 +1,251 @@
+"""Router app — packet-in dispatcher and flow installer.
+
+Equivalent of the reference's ``Router`` (reference: sdnmpi/router.py:37-195):
+filters LLDP/broadcast/IPv6-multicast packet-ins, routes normal unicast via
+``FindRouteRequest``, decodes SDN-MPI virtual MACs and resolves ranks for
+MPI packets, installs one flow per hop with de-duplication against the
+SwitchFDB, rewrites virtual -> real destination MAC on the last hop, sends
+the triggering packet out of the ingress switch, and falls back to a
+controlled broadcast when no route exists.
+
+Upgrade over the reference: flow lifecycle management. The reference
+installs permanent flows and never removes them (SURVEY §2 defects — stale
+routes survive link failures and process exits). Here, topology mutations
+trigger revalidation of every installed (src, dst) flow against a fresh
+oracle batch — stale hops are deleted from the switches, surviving routes
+are eagerly reinstalled along their new path — and an MPI process exit
+tears down the flows addressed to its rank's virtual MAC.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.bus import EventBus
+from sdnmpi_tpu.core.switch_fdb import SwitchFDB
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.vmac import VirtualMac, is_sdn_mpi_addr
+from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
+
+log = logging.getLogger("Router")
+
+
+class Router:
+    name = "Router"
+
+    def __init__(
+        self,
+        bus: EventBus,
+        southbound,
+        config: Config = DEFAULT_CONFIG,
+    ) -> None:
+        self.bus = bus
+        self.southbound = southbound
+        self.config = config
+        self.fdb = SwitchFDB()
+        #: live datapaths (reference: router.py:69-81 keeps self.dps)
+        self.dps: set[int] = set()
+
+        bus.subscribe(ev.EventDatapathUp, lambda e: self.dps.add(e.dpid))
+        bus.subscribe(ev.EventDatapathDown, self._datapath_down)
+        bus.subscribe(ev.EventPacketIn, self._packet_in)
+        bus.subscribe(ev.EventTopologyChanged, lambda e: self._revalidate_flows())
+        bus.subscribe(ev.EventProcessDelete, self._process_delete)
+        bus.provide(ev.CurrentFDBRequest, self._current_fdb)
+
+    # -- flow plumbing ----------------------------------------------------
+
+    def _add_flow(
+        self,
+        dpid: int,
+        src: str,
+        dst: str,
+        out_port: int,
+        actions: tuple[of.Action, ...] = (),
+    ) -> None:
+        # match on (dl_src, dl_dst) exactly like the reference
+        # (router.py:49-62); for MPI flows dst is the *virtual* MAC so the
+        # whole path forwards on it and only the last hop rewrites
+        mod = of.FlowMod(
+            match=of.Match(dl_src=src, dl_dst=dst),
+            actions=actions + (of.ActionOutput(out_port),),
+            priority=self.config.priority_default,
+        )
+        self.southbound.flow_mod(dpid, mod)
+
+    def _del_flow(self, dpid: int, src: str, dst: str) -> None:
+        mod = of.FlowMod(
+            match=of.Match(dl_src=src, dl_dst=dst),
+            actions=(),
+            priority=self.config.priority_default,
+            command=of.OFPFC_DELETE,
+        )
+        self.southbound.flow_mod(dpid, mod)
+
+    def _add_flows_for_path(
+        self,
+        fdb: list[tuple[int, int]],
+        src: str,
+        dst: str,
+        true_dst: str | None = None,
+    ) -> None:
+        """Install one flow per hop (reference: router.py:83-104)."""
+        for idx, (dpid, out_port) in enumerate(fdb):
+            if self.fdb.exists(dpid, src, dst):
+                continue
+            if dpid not in self.dps:
+                # don't record hops we couldn't install: recording them
+                # would dedup-suppress the install forever once the
+                # datapath returns
+                continue
+            self.fdb.update(dpid, src, dst, out_port)
+            self.bus.publish(ev.EventFDBUpdate(dpid, src, dst, out_port))
+
+            if true_dst and idx == len(fdb) - 1:
+                # virtual -> real MAC rewrite on the final hop
+                # (reference: router.py:98-102)
+                self._add_flow(
+                    dpid, src, dst, out_port, (of.ActionSetDlDst(true_dst),)
+                )
+            else:
+                self._add_flow(dpid, src, dst, out_port)
+
+    def _send_packet_out(
+        self, fdb: list[tuple[int, int]], dpid: int, pkt: of.Packet
+    ) -> None:
+        """Emit the triggering packet from the ingress switch only
+        (reference: router.py:106-123)."""
+        for entry_dpid, out_port in fdb:
+            if entry_dpid == dpid:
+                out = of.PacketOut(data=pkt, actions=(of.ActionOutput(out_port),))
+                self.southbound.packet_out(dpid, out)
+                break
+
+    # -- packet-in dispatch (reference: router.py:125-160) ----------------
+
+    def _packet_in(self, event: ev.EventPacketIn) -> None:
+        pkt = event.pkt
+        src, dst = pkt.eth_src, pkt.eth_dst
+
+        if pkt.eth_type == of.ETH_TYPE_LLDP:
+            return
+        if dst == BROADCAST_MAC:
+            return  # broadcasts are the TopologyManager's job
+        if is_ipv6_multicast(dst):
+            return
+        if is_sdn_mpi_addr(dst):
+            return self._mpi_packet_in(event)
+
+        log.info("Packet in at %s (%s) %s -> %s", event.dpid, event.in_port, src, dst)
+
+        fdb = self.bus.request(ev.FindRouteRequest(src, dst)).fdb
+        if fdb:
+            self._add_flows_for_path(fdb, src, dst)
+            self._send_packet_out(fdb, event.dpid, pkt)
+        else:
+            self.bus.request(ev.BroadcastRequest(pkt, event.dpid, event.in_port))
+
+    # -- MPI packets (reference: router.py:166-195) -----------------------
+
+    def _mpi_packet_in(self, event: ev.EventPacketIn) -> None:
+        pkt = event.pkt
+        vmac = VirtualMac.decode(pkt.eth_dst)
+        log.info(
+            "SDNMPI communication from rank %s to rank %s (collective %s)",
+            vmac.src_rank,
+            vmac.dst_rank,
+            vmac.coll_type,
+        )
+
+        true_dst = self.bus.request(ev.RankResolutionRequest(vmac.dst_rank)).mac
+        if not true_dst:
+            return  # unresolved rank -> drop (reference: router.py:186-187)
+
+        fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
+        if fdb:
+            self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
+            self._send_packet_out(fdb, event.dpid, pkt)
+
+    # -- flow lifecycle (no reference equivalent; SURVEY §2/§5) -----------
+
+    def _datapath_down(self, event: ev.EventDatapathDown) -> None:
+        self.dps.discard(event.dpid)
+        for (src, dst), _ in list(self.fdb.fdb.get(event.dpid, {}).items()):
+            self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
+        self.fdb.remove_switch(event.dpid)
+
+    def _effective_dst(self, dst: str) -> str | None:
+        """The MAC a flow actually targets: for MPI flows the dst is a
+        virtual MAC and the real target is the rank's current host."""
+        if not is_sdn_mpi_addr(dst):
+            return dst
+        try:
+            vmac = VirtualMac.decode(dst)
+        except ValueError:
+            return dst
+        return self.bus.request(ev.RankResolutionRequest(vmac.dst_rank)).mac
+
+    def _revalidate_flows(self) -> None:
+        """Recompute every installed route after a topology change; tear
+        down hops that no longer lie on the chosen path and eagerly
+        reinstall the surviving routes."""
+        flows: dict[tuple[str, str], dict[int, int]] = {}
+        for dpid, src, dst, port in self.fdb.entries():
+            flows.setdefault((src, dst), {})[dpid] = port
+        if not flows:
+            return
+
+        resolved: list[tuple[tuple[str, str], str]] = []
+        for src, dst in flows:
+            effective = self._effective_dst(dst)
+            if effective is None:
+                # the rank behind this vMAC is gone: tear it all down
+                for dpid, _ in flows[(src, dst)].items():
+                    self.fdb.remove(dpid, src, dst)
+                    self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
+                    if dpid in self.dps:
+                        self._del_flow(dpid, src, dst)
+                continue
+            resolved.append(((src, dst), effective))
+
+        fdbs = self.bus.request(
+            ev.FindRoutesBatchRequest([(src, eff) for (src, _), eff in resolved])
+        ).fdbs
+
+        for ((src, dst), effective), new_fdb in zip(resolved, fdbs):
+            installed = flows[(src, dst)]
+            new_hops = dict(new_fdb)
+            for dpid, port in installed.items():
+                if new_hops.get(dpid) != port:
+                    self.fdb.remove(dpid, src, dst)
+                    self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
+                    if dpid in self.dps:
+                        self._del_flow(dpid, src, dst)
+            if new_fdb:
+                true_dst = effective if is_sdn_mpi_addr(dst) else None
+                self._add_flows_for_path(new_fdb, src, dst, true_dst)
+
+    def _process_delete(self, event: ev.EventProcessDelete) -> None:
+        """Tear down flows addressed to the exited rank's virtual MAC."""
+        doomed = []
+        for dpid, src, dst, _ in list(self.fdb.entries()):
+            if not is_sdn_mpi_addr(dst):
+                continue
+            try:
+                vmac = VirtualMac.decode(dst)
+            except ValueError:
+                continue
+            if vmac.dst_rank == event.rank:
+                doomed.append((dpid, src, dst))
+        for dpid, src, dst in doomed:
+            self.fdb.remove(dpid, src, dst)
+            self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
+            if dpid in self.dps:
+                self._del_flow(dpid, src, dst)
+
+    # -- snapshots --------------------------------------------------------
+
+    def _current_fdb(self, req: ev.CurrentFDBRequest) -> ev.CurrentFDBReply:
+        return ev.CurrentFDBReply(self.fdb)

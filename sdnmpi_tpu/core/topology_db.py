@@ -100,7 +100,12 @@ def _entity_dict(obj: Any) -> Any:
 
 
 class TopologyDB:
-    def __init__(self, backend: str = "jax") -> None:
+    def __init__(
+        self,
+        backend: str = "jax",
+        pad_multiple: int = 8,
+        max_diameter: int = 0,
+    ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
         # src dpid -> dst dpid -> link entity (directed; the discovery layer
@@ -109,6 +114,8 @@ class TopologyDB:
         # MAC -> host entity
         self.hosts: dict[str, Any] = {}
         self.backend = backend
+        self.pad_multiple = pad_multiple
+        self.max_diameter = max_diameter
         self._version = 0
         self._oracle = None  # lazily-created JAX oracle (oracle/engine.py)
 
@@ -248,7 +255,7 @@ class TopologyDB:
         if self._oracle is None:
             from sdnmpi_tpu.oracle.engine import RouteOracle
 
-            self._oracle = RouteOracle()
+            self._oracle = RouteOracle(self.pad_multiple, self.max_diameter)
         return self._oracle
 
 

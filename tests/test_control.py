@@ -1,0 +1,286 @@
+"""End-to-end control-plane integration tests.
+
+These drive the full stack — simulated fabric, event bus, all four apps —
+through the reference's operational scenarios (SURVEY §3 call stacks):
+discovery, announcement-driven process lifecycle, unicast routing with
+flow install + packet-out, MPI virtual-MAC routing with last-hop rewrite,
+broadcast fallback, link-failure recovery, and monitoring. The reference
+had no such layer (its integration testing was manual Mininet runs).
+"""
+
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.fabric import Fabric
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+# NB: byte0 must not have the 0x02 (locally-administered) bit set — the
+# router classifies such destinations as SDN-MPI virtual MACs, exactly like
+# the reference (router.py:162-164)
+MAC = {i: f"04:00:00:00:00:0{i}" for i in (1, 2, 3, 4)}
+
+
+def make_diamond():
+    """The reference's 4-switch diamond as a live fabric."""
+    fabric = Fabric()
+    for d in (1, 2, 3, 4):
+        fabric.add_switch(d)
+    fabric.add_link(1, 2, 2, 2)
+    fabric.add_link(1, 3, 3, 3)
+    fabric.add_link(2, 3, 4, 2)
+    fabric.add_link(3, 2, 4, 3)
+    for d in (1, 2, 3, 4):
+        fabric.add_host(MAC[d], d, 1)
+    return fabric
+
+
+@pytest.fixture(params=["py", "jax"])
+def stack(request):
+    fabric = make_diamond()
+    config = Config(oracle_backend=request.param)
+    controller = Controller(fabric, config)
+    controller.attach()
+    return fabric, controller
+
+
+def ip_packet(src, dst, **kw):
+    return of.Packet(eth_src=src, eth_dst=dst, eth_type=of.ETH_TYPE_IP, **kw)
+
+
+def announce(fabric, mac, ann_type, rank):
+    pkt = of.Packet(
+        eth_src=mac,
+        eth_dst="ff:ff:ff:ff:ff:ff",
+        eth_type=of.ETH_TYPE_IP,
+        ip_proto=of.IPPROTO_UDP,
+        udp_dst=61000,
+        payload=Announcement(ann_type, rank).encode(),
+    )
+    fabric.hosts[mac].send(pkt)
+
+
+class TestDiscovery:
+    def test_topology_populated(self, stack):
+        fabric, controller = stack
+        db = controller.topology_manager.topologydb
+        assert sorted(db.switches) == [1, 2, 3, 4]
+        assert len(db.hosts) == 4
+        assert db.links[1].keys() == {2, 3}
+
+    def test_bootstrap_flows_installed(self, stack):
+        fabric, controller = stack
+        sw = fabric.switches[1]
+        prios = [e.priority for e in sw.flow_table]
+        assert 0xFFFE in prios  # broadcast -> controller
+        assert 0xFFFF in prios  # announcement -> controller
+
+
+class TestProcessLifecycle:
+    def test_launch_and_exit(self, stack):
+        fabric, controller = stack
+        added, deleted = [], []
+        controller.bus.subscribe(ev.EventProcessAdd, lambda e: added.append(e))
+        controller.bus.subscribe(ev.EventProcessDelete, lambda e: deleted.append(e))
+
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[4], AnnouncementType.LAUNCH, 1)
+        rankdb = controller.process_manager.rankdb
+        assert rankdb.get_mac(0) == MAC[1]
+        assert rankdb.get_mac(1) == MAC[4]
+        assert [(e.rank, e.mac) for e in added] == [(0, MAC[1]), (1, MAC[4])]
+
+        announce(fabric, MAC[1], AnnouncementType.EXIT, 0)
+        assert rankdb.get_mac(0) is None
+        assert [e.rank for e in deleted] == [0]
+
+    def test_announcement_not_flooded_to_hosts(self, stack):
+        fabric, controller = stack
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        for mac in (MAC[2], MAC[3], MAC[4]):
+            assert fabric.hosts[mac].received == []
+
+    def test_malformed_announcement_ignored(self, stack):
+        fabric, controller = stack
+        pkt = of.Packet(
+            eth_src=MAC[1],
+            eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP,
+            ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=b"\x01",
+        )
+        fabric.hosts[MAC[1]].send(pkt)
+        assert len(controller.process_manager.rankdb) == 0
+
+
+class TestUnicastRouting:
+    def test_first_packet_installs_flows_and_delivers(self, stack):
+        fabric, controller = stack
+        updates = []
+        controller.bus.subscribe(ev.EventFDBUpdate, lambda e: updates.append(e))
+
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+
+        # delivered end to end
+        assert [p.eth_dst for p in fabric.hosts[MAC[4]].received] == [MAC[4]]
+        # flows installed along the deterministic shortest path 1-2-4
+        assert [(u.dpid, u.port) for u in updates] == [(1, 2), (2, 3), (4, 1)]
+        assert controller.router.fdb.exists(1, MAC[1], MAC[4])
+
+    def test_second_packet_bypasses_controller(self, stack):
+        fabric, controller = stack
+        seen = []
+        controller.bus.subscribe(ev.EventPacketIn, lambda e: seen.append(e))
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        n_first = len(seen)
+        assert n_first == 1  # one table miss at the ingress switch only
+
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert len(seen) == n_first  # no new packet-ins: flows forwarded it
+        assert len(fabric.hosts[MAC[4]].received) == 2
+
+    def test_unknown_dst_falls_back_to_broadcast(self, stack):
+        fabric, controller = stack
+        ghost = "04:00:00:00:00:99"
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], ghost))
+        # flooded out of every edge port except the ingress
+        for mac in (MAC[2], MAC[3], MAC[4]):
+            assert len(fabric.hosts[mac].received) == 1
+        assert fabric.hosts[MAC[1]].received == []
+
+    def test_broadcast_floods_except_ingress(self, stack):
+        fabric, controller = stack
+        fabric.hosts[MAC[2]].send(ip_packet(MAC[2], "ff:ff:ff:ff:ff:ff"))
+        for mac in (MAC[1], MAC[3], MAC[4]):
+            assert len(fabric.hosts[mac].received) == 1
+        assert fabric.hosts[MAC[2]].received == []
+
+
+class TestMpiRouting:
+    def test_virtual_mac_route_with_rewrite(self, stack):
+        fabric, controller = stack
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[4], AnnouncementType.LAUNCH, 1)
+
+        vmac = VirtualMac(CollectiveType.P2P, src_rank=0, dst_rank=1).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+
+        # delivered with the *real* MAC after last-hop rewrite
+        received = fabric.hosts[MAC[4]].received
+        assert len(received) == 1
+        assert received[0].eth_dst == MAC[4]
+        # flows match the virtual dst along the path (reference semantics:
+        # only the final switch rewrites, router.py:96-104)
+        assert controller.router.fdb.exists(1, MAC[1], vmac)
+        assert controller.router.fdb.exists(4, MAC[1], vmac)
+        # subsequent packets bypass the controller entirely
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+        assert len(fabric.hosts[MAC[4]].received) == 2
+        assert fabric.hosts[MAC[4]].received[1].eth_dst == MAC[4]
+
+    def test_unresolved_rank_drops(self, stack):
+        fabric, controller = stack
+        vmac = VirtualMac(CollectiveType.P2P, src_rank=0, dst_rank=7).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+        for mac in MAC.values():
+            assert fabric.hosts[mac].received == []
+
+    def test_process_exit_tears_down_flows(self, stack):
+        fabric, controller = stack
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[4], AnnouncementType.LAUNCH, 1)
+        vmac = VirtualMac(CollectiveType.P2P, src_rank=0, dst_rank=1).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+        assert controller.router.fdb.exists(1, MAC[1], vmac)
+
+        announce(fabric, MAC[4], AnnouncementType.EXIT, 1)
+        assert not controller.router.fdb.exists(1, MAC[1], vmac)
+        # the flow is gone from the switch too
+        sw1 = fabric.switches[1]
+        assert all(
+            e.match.dl_dst != vmac for e in sw1.flow_table
+        ), "stale MPI flow left on switch"
+
+
+class TestFailureRecovery:
+    def test_link_failure_reroutes_installed_flows(self, stack):
+        fabric, controller = stack
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert controller.router.fdb.exists(2, MAC[1], MAC[4])  # via switch 2
+
+        seen = []
+        controller.bus.subscribe(ev.EventPacketIn, lambda e: seen.append(e))
+        fabric.remove_link(2, 3, 4, 2)  # cut the 2-4 link
+
+        # flows were revalidated and eagerly reinstalled via switch 3
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[4])
+        assert controller.router.fdb.exists(3, MAC[1], MAC[4])
+
+        # traffic flows on the new path without touching the controller
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert len(fabric.hosts[MAC[4]].received) == 2
+        assert seen == []
+
+    def test_switch_death_prunes_fdb(self, stack):
+        fabric, controller = stack
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[2]))
+        assert controller.router.fdb.exists(2, MAC[1], MAC[2])
+        fabric.remove_switch(2)
+        assert 2 not in controller.router.dps
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[2])
+
+    def test_switch_death_reroutes_transit_flows(self, stack):
+        # flows crossing the dead switch must be rebuilt on the survivors
+        fabric, controller = stack
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert controller.router.fdb.exists(2, MAC[1], MAC[4])
+        fabric.remove_switch(2)
+        assert controller.router.fdb.exists(3, MAC[1], MAC[4])
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert len(fabric.hosts[MAC[4]].received) == 2
+
+    def test_down_datapath_not_dedup_suppressed(self, stack):
+        # a hop that couldn't be installed (datapath down) must not be
+        # recorded, or it would be suppressed forever after recovery
+        fabric, controller = stack
+        controller.bus.publish(ev.EventDatapathDown(2))
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[4])
+        controller.bus.publish(ev.EventDatapathUp(2))
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert controller.router.fdb.exists(2, MAC[1], MAC[4])
+        flows = [e for e in fabric.switches[2].flow_table if e.match.dl_src == MAC[1]]
+        assert flows, "flow missing on recovered datapath"
+
+
+class TestMonitor:
+    def test_port_stats_deltas_and_util_ingest(self, stack):
+        fabric, controller = stack
+        samples = []
+        controller.bus.subscribe(ev.EventPortStats, lambda e: samples.append(e))
+
+        controller.monitor.poll(now=100.0)  # baseline
+        assert samples == []
+
+        # move 2 packets across the 1-2-4 path
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+
+        controller.monitor.poll(now=101.0)
+        assert samples, "no stats published"
+        # switch 1 port 2 (toward switch 2) transmitted 2 packets in 1 s
+        s = {(e.dpid, e.port_no): e for e in samples}
+        assert s[(1, 2)].tx_pps == 2
+        assert s[(1, 2)].tx_bps == 2 * 14
+        # the topology manager ingested utilization for that port
+        assert controller.topology_manager.link_util[(1, 2)] == 2 * 14
+
+    def test_dead_datapath_dropped_from_polling(self, stack):
+        fabric, controller = stack
+        fabric.remove_switch(3)
+        assert 3 not in controller.monitor.datapaths
+        controller.monitor.poll(now=100.0)  # must not raise
