@@ -47,8 +47,13 @@ class Config:
     max_path_len: int = 32
     #: weight of link utilization when scoring congestion-aware routes
     congestion_alpha: float = 1.0
-    #: rounds of re-balancing when assigning ECMP next-hops to a flow batch
-    ecmp_rounds: int = 3
+    #: when an MPI packet of a known collective arrives, pre-route and
+    #: install flows for EVERY rank pair of that collective in one
+    #: load-balanced oracle batch (the north-star behavior; the reference
+    #: routes one pair per packet-in)
+    proactive_collectives: bool = True
+    #: device chunk size for the balanced-routing scan
+    ecmp_chunk: int = 4096
 
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves

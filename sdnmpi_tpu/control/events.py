@@ -132,11 +132,16 @@ class FindAllRoutesReply(Reply):
 class FindRoutesBatchRequest(Request):
     dst = "TopologyManager"
     pairs: list  # [(src_mac, dst_mac), ...]
+    #: spread the batch across equal-cost paths, seeded with the measured
+    #: link utilization the Monitor has been feeding the TopologyManager
+    balanced: bool = False
 
 
 @dataclasses.dataclass
 class FindRoutesBatchReply(Reply):
     fdbs: list
+    #: max directed-link load of the batch's chosen paths (balanced mode)
+    max_congestion: float = 0.0
 
 
 @dataclasses.dataclass

@@ -26,7 +26,7 @@ from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
 from sdnmpi_tpu.core.switch_fdb import SwitchFDB
 from sdnmpi_tpu.protocol import openflow as of
-from sdnmpi_tpu.protocol.vmac import VirtualMac, is_sdn_mpi_addr
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac, is_sdn_mpi_addr
 from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
 
 log = logging.getLogger("Router")
@@ -167,6 +167,79 @@ class Router:
         if fdb:
             self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
             self._send_packet_out(fdb, event.dpid, pkt)
+
+        if self.config.proactive_collectives and vmac.coll_type != CollectiveType.P2P:
+            self._install_collective(vmac)
+
+    def _install_collective(self, vmac: VirtualMac) -> None:
+        """Pre-route the whole collective in one load-balanced batch.
+
+        The first packet of a collective reveals its type; every rank pair
+        the collective's algorithm will send is routed in a single oracle
+        call (spread across equal-cost paths, seeded with measured link
+        utilization) and installed before those packets exist — the rest
+        of the collective never touches the controller. The reference
+        decodes the collective type but only logs it (router.py:182)."""
+        from sdnmpi_tpu.collectives import collective_pairs
+
+        rankdb = self.bus.request(ev.CurrentProcessAllocationRequest()).processes
+        ranks = rankdb.ranks()
+        n = len(ranks)
+        if n < 2:
+            return
+        # Pattern generators work in index space 0..n-1; registered rank
+        # ids need not be contiguous, so map through the sorted rank list.
+        # Root heuristic: the kickoff packet of a rooted collective is the
+        # root's first send (BCAST/SCATTER: root transmits) or a send
+        # toward the root (REDUCE/GATHER: root receives). A mid-collective
+        # first sighting can mis-root the tree — that only costs some
+        # unused proactive flows; the real pairs still route reactively.
+        root_rank = {
+            CollectiveType.BCAST: vmac.src_rank,
+            CollectiveType.SCATTER: vmac.src_rank,
+            CollectiveType.REDUCE: vmac.dst_rank,
+            CollectiveType.GATHER: vmac.dst_rank,
+        }.get(vmac.coll_type)
+        kwargs = {}
+        if root_rank is not None:
+            if root_rank not in ranks:
+                return
+            kwargs["root"] = ranks.index(root_rank)
+        try:
+            rank_pairs = collective_pairs(vmac.coll_type, n, **kwargs)
+        except ValueError:
+            return  # pattern not applicable (e.g. non-power-of-two ranks)
+
+        # ranks need not be contiguous 0..n-1; pattern indices map onto the
+        # sorted registered ranks, and the vMACs carry the *actual* ids
+        todo: list[tuple[str, str, str]] = []  # (src_mac, pair_vmac, true_dst)
+        pairs: list[tuple[str, str]] = []
+        for si, di in sorted({(int(s), int(d)) for s, d in rank_pairs}):
+            if si == di:
+                continue
+            s_rank, d_rank = ranks[si], ranks[di]
+            src_mac = rankdb.get_mac(s_rank)
+            dst_mac = rankdb.get_mac(d_rank)
+            if not src_mac or not dst_mac:
+                continue
+            pair_vmac = VirtualMac(vmac.coll_type, s_rank, d_rank).encode()
+            if self.fdb.exists_anywhere(src_mac, pair_vmac):
+                continue
+            todo.append((src_mac, pair_vmac, dst_mac))
+            pairs.append((src_mac, dst_mac))
+        if not pairs:
+            return
+
+        reply = self.bus.request(ev.FindRoutesBatchRequest(pairs, balanced=True))
+        log.info(
+            "proactive install: collective %s, %d flows, max link load %s",
+            vmac.coll_type,
+            len(pairs),
+            reply.max_congestion,
+        )
+        for (src_mac, pair_vmac, dst_mac), fdb in zip(todo, reply.fdbs):
+            if fdb:
+                self._add_flows_for_path(fdb, src_mac, pair_vmac, dst_mac)
 
     # -- flow lifecycle (no reference equivalent; SURVEY §2/§5) -----------
 

@@ -114,6 +114,14 @@ class TopologyManager:
     def _find_routes_batch(
         self, req: ev.FindRoutesBatchRequest
     ) -> ev.FindRoutesBatchReply:
+        if req.balanced:
+            fdbs, max_congestion = self.topologydb.find_routes_batch_balanced(
+                req.pairs,
+                link_util=self.link_util,
+                alpha=self.config.congestion_alpha,
+                chunk=self.config.ecmp_chunk,
+            )
+            return ev.FindRoutesBatchReply(fdbs, max_congestion)
         return ev.FindRoutesBatchReply(self.topologydb.find_routes_batch(req.pairs))
 
     def _broadcast_request(self, req: ev.BroadcastRequest) -> ev.BroadcastReply:

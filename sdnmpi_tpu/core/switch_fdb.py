@@ -39,6 +39,10 @@ class SwitchFDB:
     def remove_switch(self, dpid: int) -> None:
         self.fdb.pop(dpid, None)
 
+    def exists_anywhere(self, src: str, dst: str) -> bool:
+        """True if any switch has a flow for this (src, dst) pair."""
+        return any((src, dst) in table for table in self.fdb.values())
+
     def entries(self) -> Iterator[tuple[int, str, str, int]]:
         for dpid, table in self.fdb.items():
             for (src, dst), port in table.items():

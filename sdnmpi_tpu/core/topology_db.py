@@ -239,6 +239,31 @@ class TopologyDB:
             return self._jax_oracle().routes_batch(self, pairs)
         return [self.find_route(s, d) for s, d in pairs]
 
+    def find_routes_batch_balanced(
+        self,
+        pairs: list[tuple[str, str]],
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        alpha: float = 1.0,
+        chunk: int = 4096,
+    ) -> tuple[list[list[tuple[int, int]]], float]:
+        """Load-aware batched routing: the whole batch is spread across
+        equal-cost paths on device, seeded with measured link utilization
+        (oracle/congestion.py). Returns (fdbs, max_congestion).
+
+        The pure-Python backend has no balancing; it degrades to the plain
+        batch with a congestion figure computed from the chosen paths.
+        """
+        if self.backend == "jax":
+            return self._jax_oracle().routes_batch_balanced(
+                self, pairs, link_util, alpha, chunk
+            )
+        fdbs = [self.find_route(s, d) for s, d in pairs]
+        load: dict[tuple[int, int], float] = {}
+        for fdb in fdbs:
+            for (a, _), (b, _) in zip(fdb, fdb[1:]):
+                load[(a, b)] = load.get((a, b), 0.0) + 1.0
+        return fdbs, max(load.values(), default=0.0)
+
     # -- backend dispatch ------------------------------------------------
 
     def _shortest_route(self, src_dpid: int, dst_dpid: int) -> list[int]:

@@ -167,22 +167,20 @@ class RouteOracle:
         walk(si, [si])
         return routes
 
-    def routes_batch(
-        self, db: "TopologyDB", pairs: list[tuple[str, str]]
-    ) -> list[list[tuple[int, int]]]:
-        """Resolve a batch of (src_mac, dst_mac) pairs to fdbs.
-
-        Endpoint resolution happens on host; the hop/port extraction for
-        the whole batch is a single device call (oracle/paths.batch_fdb).
-        ``max_len`` is derived from the batch's true maximum distance, so
-        no reachable flow can be truncated; it is rounded up to a multiple
-        of 8 to keep the jit cache small.
-        """
+    def _resolve_rows(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        t: TopoTensors,
+        results: list,
+    ) -> list[tuple[int, int, int, int]]:
+        """Map (src_mac, dst_mac) pairs to (pair idx, src idx, dst idx,
+        final out-port) rows. Unresolvable pairs keep their [] in
+        ``results``; pairs whose dpid somehow escaped tensorization fall
+        back to the scalar path."""
         from sdnmpi_tpu.protocol.openflow import OFPP_LOCAL
 
-        t = self.refresh(db)
-        results: list[list[tuple[int, int]]] = [[] for _ in pairs]
-        rows: list[tuple[int, int, int, int]] = []  # (pair idx, si, di, port)
+        rows: list[tuple[int, int, int, int]] = []
         for k, (src_mac, dst_mac) in enumerate(pairs):
             src = db._resolve_endpoint(src_mac)
             dst = db._resolve_endpoint(dst_mac)
@@ -199,7 +197,30 @@ class RouteOracle:
                 continue
             port = OFPP_LOCAL if is_local_dst else db.hosts[dst_mac].port.port_no
             rows.append((k, si, di, port))
+        return rows
 
+    def _batch_max_len(self, src_idx: np.ndarray, dst_idx: np.ndarray) -> int:
+        """Hop budget covering the batch's true maximum distance (no
+        reachable flow can be truncated), rounded up to a multiple of 8 to
+        keep the jit cache small. 0 means nothing is reachable."""
+        sel = self._dist[src_idx, dst_idx]
+        finite = np.isfinite(sel)
+        if not finite.any():
+            return 0
+        needed = int(sel[finite].max()) + 1
+        return ((needed + 7) // 8) * 8
+
+    def routes_batch(
+        self, db: "TopologyDB", pairs: list[tuple[str, str]]
+    ) -> list[list[tuple[int, int]]]:
+        """Resolve a batch of (src_mac, dst_mac) pairs to fdbs.
+
+        Endpoint resolution happens on host; the hop/port extraction for
+        the whole batch is a single device call (oracle/paths.batch_fdb).
+        """
+        t = self.refresh(db)
+        results: list[list[tuple[int, int]]] = [[] for _ in pairs]
+        rows = self._resolve_rows(db, pairs, t, results)
         if not rows:
             return results
 
@@ -207,12 +228,9 @@ class RouteOracle:
         dst_idx = np.array([r[2] for r in rows], dtype=np.int32)
         final_port = np.array([r[3] for r in rows], dtype=np.int32)
 
-        sel = self._dist[src_idx, dst_idx]
-        finite = np.isfinite(sel)
-        if not finite.any():
+        max_len = self._batch_max_len(src_idx, dst_idx)
+        if max_len == 0:
             return results
-        needed = int(sel[finite].max()) + 1
-        max_len = ((needed + 7) // 8) * 8
 
         nodes, ports, length = batch_fdb(
             jnp.asarray(self._next),
@@ -233,6 +251,63 @@ class RouteOracle:
                 for h in range(int(length[f]))
             ]
         return results
+
+    def routes_batch_balanced(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        alpha: float = 1.0,
+        chunk: int = 4096,
+    ) -> tuple[list[list[tuple[int, int]]], float]:
+        """Load-aware batch routing (oracle/congestion.py): spreads the
+        batch across equal-cost paths, seeded with measured utilization.
+
+        Returns (fdbs, max_congestion). Unlike ``routes_batch`` the chosen
+        paths depend on the whole batch, not just the endpoints.
+        """
+        from sdnmpi_tpu.oracle.congestion import (
+            route_flows_balanced,
+            utilization_matrix,
+        )
+
+        t = self.refresh(db)
+        results: list[list[tuple[int, int]]] = [[] for _ in pairs]
+        rows = self._resolve_rows(db, pairs, t, results)
+        if not rows:
+            return results, 0.0
+
+        src_idx = np.array([r[1] for r in rows], dtype=np.int32)
+        dst_idx = np.array([r[2] for r in rows], dtype=np.int32)
+        max_len = self._batch_max_len(src_idx, dst_idx)
+        if max_len == 0:
+            return results, 0.0
+
+        base = utilization_matrix(t, link_util or {}) * alpha
+        nodes, _, maxc = route_flows_balanced(
+            t.adj,
+            jnp.asarray(self._dist),
+            jnp.asarray(base),
+            jnp.asarray(src_idx),
+            jnp.asarray(dst_idx),
+            jnp.ones(len(rows), np.float32),
+            max_len,
+            chunk=chunk,
+        )
+        nodes = np.asarray(nodes)
+        port_mat = np.asarray(t.port)
+        dpids = t.dpids
+        for f, (k, _, _, final_port) in enumerate(rows):
+            path = nodes[f][nodes[f] >= 0]
+            if len(path) == 0:
+                continue
+            fdb = [
+                (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
+                for h in range(len(path) - 1)
+            ]
+            fdb.append((int(dpids[path[-1]]), final_port))
+            results[k] = fdb
+        return results, float(maxc)
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------
 

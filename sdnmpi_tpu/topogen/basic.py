@@ -1,0 +1,93 @@
+"""Basic topology generators: linear, ring, 2D torus, random regular."""
+
+from __future__ import annotations
+
+import random
+
+from sdnmpi_tpu.topogen.spec import PortAllocator, TopoSpec, host_mac
+
+
+def linear(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
+    """Switches 1..n in a chain (bench config 1's 4-switch linear topo)."""
+    ports = PortAllocator()
+    switches = list(range(1, n_switches + 1))
+    hosts = []
+    host_id = 0
+    for dpid in switches:
+        for _ in range(hosts_per_switch):
+            hosts.append((host_mac(host_id), dpid, ports.take(dpid)))
+            host_id += 1
+    links = []
+    for a in range(1, n_switches):
+        links.append((a, ports.take(a), a + 1, ports.take(a + 1)))
+    return TopoSpec(f"linear-{n_switches}", switches, links, hosts)
+
+
+def ring(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
+    spec = linear(n_switches, hosts_per_switch)
+    ports = PortAllocator()
+    # continue numbering beyond already-used ports
+    used = {}
+    for a, pa, b, pb in spec.links:
+        used[a] = max(used.get(a, 0), pa)
+        used[b] = max(used.get(b, 0), pb)
+    for mac, dpid, p in spec.hosts:
+        used[dpid] = max(used.get(dpid, 0), p)
+    ports._next = {d: p + 1 for d, p in used.items()}
+    spec.links.append((n_switches, ports.take(n_switches), 1, ports.take(1)))
+    spec.name = f"ring-{n_switches}"
+    return spec
+
+
+def torus2d(nx: int, ny: int, hosts_per_switch: int = 1) -> TopoSpec:
+    """2D torus with wraparound in both dimensions."""
+    ports = PortAllocator()
+
+    def dpid(x: int, y: int) -> int:
+        return y * nx + x + 1
+
+    switches = [dpid(x, y) for y in range(ny) for x in range(nx)]
+    hosts = []
+    host_id = 0
+    for s in switches:
+        for _ in range(hosts_per_switch):
+            hosts.append((host_mac(host_id), s, ports.take(s)))
+            host_id += 1
+    links = []
+    for y in range(ny):
+        for x in range(nx):
+            a = dpid(x, y)
+            right = dpid((x + 1) % nx, y)
+            down = dpid(x, (y + 1) % ny)
+            if nx > 1:
+                links.append((a, ports.take(a), right, ports.take(right)))
+            if ny > 1:
+                links.append((a, ports.take(a), down, ports.take(down)))
+    return TopoSpec(f"torus-{nx}x{ny}", switches, links, hosts)
+
+
+def random_regular(
+    n_switches: int, degree: int, hosts_per_switch: int = 1, seed: int = 0
+) -> TopoSpec:
+    """Random connected-ish graph: a ring plus random extra edges up to the
+    target degree. Used for differential/fuzz testing, not benchmarks."""
+    rng = random.Random(seed)
+    spec = ring(n_switches, hosts_per_switch)
+    have = {(a, b) for a, _, b, _ in spec.links} | {
+        (b, a) for a, _, b, _ in spec.links
+    }
+    ports = PortAllocator()
+    ports._next = {d: 100 for d in spec.switches}  # link ports from 100 up
+    deg = {d: 2 for d in spec.switches}
+    attempts = n_switches * degree * 4
+    for _ in range(attempts):
+        a, b = rng.sample(spec.switches, 2)
+        if (a, b) in have or deg[a] >= degree or deg[b] >= degree:
+            continue
+        have.add((a, b))
+        have.add((b, a))
+        deg[a] += 1
+        deg[b] += 1
+        spec.links.append((a, ports.take(a), b, ports.take(b)))
+    spec.name = f"random-{n_switches}x{degree}"
+    return spec

@@ -1,0 +1,60 @@
+"""Three-level fat-tree generator (the north-star benchmark topology).
+
+Standard k-ary fat-tree: k pods, each with k/2 edge and k/2 aggregation
+switches; (k/2)^2 core switches; every edge switch serves k/2 hosts.
+Totals: 5k^2/4 switches, k^3/4 hosts, full bisection bandwidth.
+k=16 -> 320 switches / 1024 hosts; k=28 -> 980 switches / 5488 hosts
+(the "1024-switch fat-tree" bench config, padded to 1024 in the oracle).
+"""
+
+from __future__ import annotations
+
+from sdnmpi_tpu.topogen.spec import PortAllocator, TopoSpec, host_mac
+
+
+def fattree(k: int, hosts_per_edge: int | None = None) -> TopoSpec:
+    if k % 2:
+        raise ValueError("fat-tree arity k must be even")
+    half = k // 2
+    if hosts_per_edge is None:
+        hosts_per_edge = half
+
+    # dpid layout: cores first, then per pod: aggs, then edges
+    n_core = half * half
+    core = [1 + i for i in range(n_core)]
+
+    def agg(pod: int, a: int) -> int:
+        return 1 + n_core + pod * k + a
+
+    def edge(pod: int, e: int) -> int:
+        return 1 + n_core + pod * k + half + e
+
+    switches = list(core)
+    for pod in range(k):
+        switches.extend(agg(pod, a) for a in range(half))
+        switches.extend(edge(pod, e) for e in range(half))
+
+    ports = PortAllocator()
+    links = []
+    hosts = []
+    host_id = 0
+
+    for pod in range(k):
+        for e in range(half):
+            e_dpid = edge(pod, e)
+            # hosts first so host ports are the low numbers
+            for _ in range(hosts_per_edge):
+                hosts.append((host_mac(host_id), e_dpid, ports.take(e_dpid)))
+                host_id += 1
+            # edge <-> every agg in the pod
+            for a in range(half):
+                a_dpid = agg(pod, a)
+                links.append((e_dpid, ports.take(e_dpid), a_dpid, ports.take(a_dpid)))
+        # agg a <-> cores [a*half, (a+1)*half)
+        for a in range(half):
+            a_dpid = agg(pod, a)
+            for j in range(half):
+                c_dpid = core[a * half + j]
+                links.append((a_dpid, ports.take(a_dpid), c_dpid, ports.take(c_dpid)))
+
+    return TopoSpec(f"fattree-k{k}", switches, links, hosts)

@@ -1,0 +1,119 @@
+"""Collective pattern generator tests: shapes, coverage, correctness."""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.collectives import (
+    allgather_ring_pairs,
+    allreduce_recursive_doubling_pairs,
+    allreduce_ring_pairs,
+    alltoall_pairs,
+    barrier_dissemination_pairs,
+    bcast_binomial_pairs,
+    collective_pairs,
+    gather_pairs,
+    reduce_binomial_pairs,
+    scatter_pairs,
+)
+from sdnmpi_tpu.protocol.vmac import CollectiveType
+
+
+class TestAlltoall:
+    def test_complete_traffic_matrix(self):
+        pairs = alltoall_pairs(4)
+        assert pairs.shape == (12, 2)
+        assert len({tuple(p) for p in pairs.tolist()}) == 12
+        assert not any(s == d for s, d in pairs.tolist())
+
+
+class TestBcast:
+    def test_binomial_tree_covers_all_ranks(self):
+        for n in (2, 5, 8, 16):
+            pairs = bcast_binomial_pairs(n, root=0)
+            assert len(pairs) == n - 1  # tree: each rank receives once
+            reached = {0}
+            for s, d in pairs.tolist():
+                assert s in reached, "sender must already hold the data"
+                reached.add(d)
+            assert reached == set(range(n))
+
+    def test_nonzero_root(self):
+        pairs = bcast_binomial_pairs(5, root=3)
+        reached = {3}
+        for s, d in pairs.tolist():
+            assert s in reached
+            reached.add(d)
+        assert reached == set(range(5))
+
+    def test_rounds_are_log2(self):
+        _, rounds = bcast_binomial_pairs(16, with_rounds=True)
+        assert rounds.max() == 3
+
+
+class TestReduce:
+    def test_reverse_of_bcast(self):
+        pairs = reduce_binomial_pairs(8, root=0)
+        bcast = bcast_binomial_pairs(8, root=0)
+        assert sorted(map(tuple, pairs[:, ::-1].tolist())) == sorted(
+            map(tuple, bcast.tolist())
+        )
+
+    def test_leaf_rounds_first(self):
+        pairs, rounds = reduce_binomial_pairs(8, root=0, with_rounds=True)
+        assert (np.diff(rounds) >= 0).all()
+        # the last round sends into the root
+        assert pairs[rounds == rounds.max()][:, 1].tolist() == [0]
+
+
+class TestRings:
+    def test_allreduce_ring(self):
+        pairs, rounds = allreduce_ring_pairs(4, with_rounds=True)
+        assert len(pairs) == 2 * 3 * 4  # 2(n-1) rounds x n sends
+        assert rounds.max() == 5
+        for s, d in pairs.tolist():
+            assert d == (s + 1) % 4
+
+    def test_allgather_ring(self):
+        pairs = allgather_ring_pairs(4)
+        assert len(pairs) == 3 * 4
+
+
+class TestRecursiveDoubling:
+    def test_power_of_two(self):
+        pairs, rounds = allreduce_recursive_doubling_pairs(8, with_rounds=True)
+        assert len(pairs) == 3 * 8
+        for (s, d), k in zip(pairs.tolist(), rounds.tolist()):
+            assert d == s ^ (1 << k)
+
+    def test_rejects_non_power_of_two(self):
+        with pytest.raises(ValueError):
+            allreduce_recursive_doubling_pairs(6)
+
+
+class TestRooted:
+    def test_gather_scatter(self):
+        g = gather_pairs(5, root=2)
+        s = scatter_pairs(5, root=2)
+        assert (g[:, 1] == 2).all()
+        assert (s[:, 0] == 2).all()
+        assert len(g) == len(s) == 4
+
+
+class TestBarrier:
+    def test_dissemination(self):
+        pairs, rounds = barrier_dissemination_pairs(5, with_rounds=True)
+        assert rounds.max() == 2  # ceil(log2(5)) - 1
+        for (s, d), k in zip(pairs.tolist(), rounds.tolist()):
+            assert d == (s + (1 << k)) % 5
+
+
+class TestDispatch:
+    def test_by_collective_type(self):
+        pairs = collective_pairs(CollectiveType.ALLTOALL, 4)
+        assert len(pairs) == 12
+        pairs = collective_pairs(CollectiveType.BCAST, 8, root=1)
+        assert len(pairs) == 7
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError):
+            collective_pairs(42, 4)

@@ -1,0 +1,163 @@
+"""Load-aware ECMP routing tests."""
+
+import numpy as np
+
+from sdnmpi_tpu.collectives import alltoall_pairs
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.congestion import (
+    aggregate_pairs,
+    link_loads_from_paths,
+    route_flows_balanced,
+    utilization_matrix,
+)
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import fattree, host_mac
+from tests.topo_fixtures import diamond
+
+
+def _route(db, src, dst, weight=None, base=None, max_len=8):
+    t = tensorize(db)
+    dist = apsp_distances(t.adj)
+    v = t.adj.shape[0]
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
+    w = np.ones(len(src), np.float32) if weight is None else np.asarray(weight, np.float32)
+    base_cost = np.zeros((v, v), np.float32) if base is None else base
+    nodes, load, maxc = route_flows_balanced(
+        t.adj, dist, base_cost, src, dst, w, max_len, chunk=4
+    )
+    return t, np.asarray(nodes), np.asarray(load), float(maxc)
+
+
+class TestDiamondSpreading:
+    def test_two_flows_split_across_ecmp_paths(self):
+        db = diamond(backend="jax")
+        t = tensorize(db)
+        i = t.index
+        # two flows 1 -> 4: with load balancing they must take different
+        # branches (one via 2, one via 3), max link load 1 not 2
+        _, nodes, load, maxc = _route(db, [i[1], i[1]], [i[4], i[4]])
+        mids = {nodes[0, 1], nodes[1, 1]}
+        assert mids == {i[2], i[3]}
+        assert maxc == 1.0
+
+    def test_base_cost_steers_away_from_hot_link(self):
+        db = diamond(backend="jax")
+        t = tensorize(db)
+        i = t.index
+        v = t.adj.shape[0]
+        base = np.zeros((v, v), np.float32)
+        base[i[1], i[2]] = 100.0  # link 1->2 is measured hot
+        _, nodes, _, _ = _route(db, [i[1]], [i[4]], base=base)
+        assert nodes[0, 1] == i[3], "should avoid the hot 1->2 link"
+
+    def test_load_matrix_matches_paths(self):
+        db = diamond(backend="jax")
+        t = tensorize(db)
+        i = t.index
+        _, nodes, load, _ = _route(db, [i[1], i[1], i[2]], [i[4], i[4], i[3]])
+        v = t.adj.shape[0]
+        w = np.ones(3, np.float32)
+        recomputed = np.asarray(link_loads_from_paths(nodes, v, w))
+        np.testing.assert_allclose(load, recomputed)
+
+    def test_unreachable_flow_places_no_load(self):
+        db = diamond(backend="jax")
+        del db.links[1]
+        db._version += 1
+        t = tensorize(db)
+        i = t.index
+        _, nodes, load, maxc = _route(db, [i[1]], [i[4]])
+        assert (nodes[0] == -1).all()
+        assert maxc == 0.0
+
+
+class TestFatTreeAlltoall:
+    def test_alltoall_spreads_over_parallel_paths(self):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        t = tensorize(db)
+        dist = apsp_distances(t.adj)
+
+        # all 16 hosts talk to all 16 hosts
+        pairs = alltoall_pairs(16)
+        edge = {m: db.hosts[m].port.dpid for m, _, _ in spec.hosts}
+        src_sw = np.array(
+            [t.index[edge[host_mac(s)]] for s, _ in pairs], np.int32
+        )
+        dst_sw = np.array(
+            [t.index[edge[host_mac(d)]] for _, d in pairs], np.int32
+        )
+        usrc, udst, w = aggregate_pairs(src_sw, dst_sw)
+        # 8 edge switches all-to-all = 56 distinct pairs + 8 self pairs
+        assert len(usrc) == 64
+
+        v = t.adj.shape[0]
+        nodes, load, maxc = route_flows_balanced(
+            t.adj,
+            dist,
+            np.zeros((v, v), np.float32),
+            usrc,
+            udst,
+            w,
+            max_len=8,
+            chunk=16,
+        )
+        maxc = float(maxc)
+
+        # naive single-shortest-path routing (no balancing) for comparison
+        from sdnmpi_tpu.oracle.apsp import apsp_next_hops
+        from sdnmpi_tpu.oracle.paths import batch_paths
+
+        nxt = apsp_next_hops(t.adj, dist)
+        naive_nodes, _ = batch_paths(nxt, usrc, udst, max_len=8)
+        naive_load = np.asarray(
+            link_loads_from_paths(np.asarray(naive_nodes), v, w)
+        )
+        naive_max = naive_load.max()
+
+        assert maxc <= naive_max, (
+            f"balanced routing ({maxc}) must beat deterministic "
+            f"single-path ({naive_max})"
+        )
+        # in a k=4 fat-tree the alltoall should spread near-perfectly:
+        # strictly better than the single-path concentration
+        assert maxc < naive_max
+
+    def test_chunk_size_only_affects_greedy_order(self):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        t = tensorize(db)
+        dist = apsp_distances(t.adj)
+        v = t.adj.shape[0]
+        rng = np.random.default_rng(0)
+        src = rng.integers(0, t.n_real, 64).astype(np.int32)
+        dst = rng.integers(0, t.n_real, 64).astype(np.int32)
+        w = np.ones(64, np.float32)
+        base = np.zeros((v, v), np.float32)
+        _, _, maxc_small = route_flows_balanced(
+            t.adj, dist, base, src, dst, w, 8, chunk=8
+        )
+        _, _, maxc_big = route_flows_balanced(
+            t.adj, dist, base, src, dst, w, 8, chunk=64
+        )
+        # both valid assignments; congestion within 2x of each other
+        assert float(maxc_small) <= 2 * float(maxc_big) + 1e-6
+        assert float(maxc_big) <= 2 * float(maxc_small) + 1e-6
+
+
+class TestUtilizationMatrix:
+    def test_maps_port_samples_to_links(self):
+        db = diamond(backend="jax")
+        t = tensorize(db)
+        i = t.index
+        # Monitor saw (dpid 1, port 2) = link 1->2 at 5000 bps
+        util = utilization_matrix(t, {(1, 2): 5000.0})
+        assert util[i[1], i[2]] == 5000.0
+        assert util.sum() == 5000.0
+
+    def test_empty(self):
+        db = diamond(backend="jax")
+        t = tensorize(db)
+        util = utilization_matrix(t, {})
+        assert util.sum() == 0.0

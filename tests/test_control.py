@@ -206,6 +206,95 @@ class TestMpiRouting:
         ), "stale MPI flow left on switch"
 
 
+class TestProactiveCollectives:
+    def test_alltoall_preinstalls_all_rank_pairs(self, stack):
+        fabric, controller = stack
+        for i, rank in ((1, 0), (2, 1), (3, 2), (4, 3)):
+            announce(fabric, MAC[i], AnnouncementType.LAUNCH, rank)
+
+        seen = []
+        controller.bus.subscribe(ev.EventPacketIn, lambda e: seen.append(e))
+
+        # rank 0 kicks off a 4-rank alltoall: one packet to rank 1
+        vmac01 = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac01))
+        assert len(seen) == 1
+        assert fabric.hosts[MAC[2]].received[0].eth_dst == MAC[2]
+
+        # every other rank pair's flows are already installed...
+        for s in range(4):
+            for d in range(4):
+                if s == d:
+                    continue
+                pair_vmac = VirtualMac(CollectiveType.ALLTOALL, s, d).encode()
+                assert controller.router.fdb.exists_anywhere(
+                    MAC[s + 1], pair_vmac
+                ), f"missing proactive flow for rank pair {s}->{d}"
+
+        # ...so the remaining 11 sends never hit the controller
+        for s in range(4):
+            for d in range(4):
+                if s == d or (s, d) == (0, 1):
+                    continue
+                pair_vmac = VirtualMac(CollectiveType.ALLTOALL, s, d).encode()
+                fabric.hosts[MAC[s + 1]].send(ip_packet(MAC[s + 1], pair_vmac))
+        assert len(seen) == 1, "proactively-installed flows must bypass controller"
+        # each host received one packet from every peer, correctly rewritten
+        for d in range(4):
+            inbox = fabric.hosts[MAC[d + 1]].received
+            assert len(inbox) == 3
+            assert all(p.eth_dst == MAC[d + 1] for p in inbox)
+
+    def test_p2p_does_not_preinstall(self, stack):
+        fabric, controller = stack
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[4], AnnouncementType.LAUNCH, 1)
+        vmac = VirtualMac(CollectiveType.P2P, 0, 1).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+        reverse = VirtualMac(CollectiveType.P2P, 1, 0).encode()
+        assert not controller.router.fdb.exists_anywhere(MAC[4], reverse)
+
+    def test_noncontiguous_ranks_gather(self, stack):
+        # registered ranks {10, 11, 12, 25}: pattern indices must map
+        # through the sorted rank list, and GATHER's root comes from the
+        # *destination* rank of the kickoff packet (the root receives)
+        fabric, controller = stack
+        ranks = {1: 10, 2: 11, 3: 12, 4: 25}
+        for i, rank in ranks.items():
+            announce(fabric, MAC[i], AnnouncementType.LAUNCH, rank)
+        vmac = VirtualMac(CollectiveType.GATHER, 11, 10).encode()  # 11 -> root 10
+        fabric.hosts[MAC[2]].send(ip_packet(MAC[2], vmac))
+        # flows toward root 10 exist for the other senders too
+        for sender in (11, 12, 25):
+            pv = VirtualMac(CollectiveType.GATHER, sender, 10).encode()
+            sender_host = MAC[{10: 1, 11: 2, 12: 3, 25: 4}[sender]]
+            assert controller.router.fdb.exists_anywhere(sender_host, pv), (
+                f"missing gather flow {sender}->10"
+            )
+
+    def test_unregistered_root_rank_is_safe(self, stack):
+        fabric, controller = stack
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[2], AnnouncementType.LAUNCH, 1)
+        # kickoff names a root rank that is not registered -> no crash,
+        # triggering pair still routed
+        vmac = VirtualMac(CollectiveType.GATHER, 0, 7).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac))
+
+    def test_bcast_rooted_at_sender(self, stack):
+        fabric, controller = stack
+        for i, rank in ((1, 0), (2, 1), (3, 2), (4, 3)):
+            announce(fabric, MAC[i], AnnouncementType.LAUNCH, rank)
+        # rank 2 broadcasts: binomial tree rooted at 2
+        vmac = VirtualMac(CollectiveType.BCAST, 2, 3).encode()
+        fabric.hosts[MAC[3]].send(ip_packet(MAC[3], vmac))
+        # tree rooted at 2 covers pairs (2->3), (2->0), (3->1) for n=4
+        expected = [(2, 3), (2, 0), (3, 1)]
+        for s, d in expected:
+            pv = VirtualMac(CollectiveType.BCAST, s, d).encode()
+            assert controller.router.fdb.exists_anywhere(MAC[s + 1], pv)
+
+
 class TestFailureRecovery:
     def test_link_failure_reroutes_installed_flows(self, stack):
         fabric, controller = stack

@@ -1,0 +1,145 @@
+"""Topology generator structural invariants + routability."""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.topogen import dragonfly, fattree, host_mac, linear, ring, torus2d
+
+
+def degree_counts(spec):
+    deg = {d: 0 for d in spec.switches}
+    for a, _, b, _ in spec.links:
+        deg[a] += 1
+        deg[b] += 1
+    return deg
+
+
+def no_duplicate_ports(spec):
+    used = set()
+    for a, pa, b, pb in spec.links:
+        for key in ((a, pa), (b, pb)):
+            assert key not in used, f"port reused: {key}"
+            used.add(key)
+    for mac, dpid, port in spec.hosts:
+        assert (dpid, port) not in used, f"host port reused: {(dpid, port)}"
+        used.add((dpid, port))
+
+
+class TestFatTree:
+    def test_k4_structure(self):
+        spec = fattree(4)
+        # 5k^2/4 switches, k^3/4 hosts, k^3*3/8... links: edge-agg k*(k/2)^2
+        # plus agg-core k*(k/2)^2
+        assert spec.n_switches == 20
+        assert spec.n_hosts == 16
+        assert len(spec.links) == 2 * 4 * 4
+        no_duplicate_ports(spec)
+
+    def test_k8_uniform_degree(self):
+        spec = fattree(8)
+        assert spec.n_switches == 80
+        assert spec.n_hosts == 128
+        deg = degree_counts(spec)
+        # every switch has k link endpoints except edges, which have k/2
+        # links + k/2 hosts
+        hosts_by_switch = {}
+        for _, dpid, _ in spec.hosts:
+            hosts_by_switch[dpid] = hosts_by_switch.get(dpid, 0) + 1
+        for dpid in spec.switches:
+            assert deg[dpid] + hosts_by_switch.get(dpid, 0) == 8
+
+    def test_odd_k_rejected(self):
+        with pytest.raises(ValueError):
+            fattree(5)
+
+    def test_all_pairs_routable_and_diameter(self):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        from sdnmpi_tpu.oracle.engine import tensorize
+        from sdnmpi_tpu.oracle.apsp import apsp_distances
+
+        t = tensorize(db)
+        dist = np.asarray(apsp_distances(t.adj))
+        real = dist[: t.n_real, : t.n_real]
+        assert np.isfinite(real).all(), "fat-tree must be connected"
+        # 3-level fat-tree switch diameter = 4 (edge-agg-core-agg-edge)
+        assert real.max() == 4
+
+    def test_host_routes(self):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        # first and last host are in different pods -> 4 switch hops + host
+        fdb = db.find_route(host_mac(0), host_mac(15))
+        assert len(fdb) == 5
+        # same edge switch -> single hop to the host port
+        fdb = db.find_route(host_mac(0), host_mac(1))
+        assert len(fdb) == 1
+
+
+class TestDragonfly:
+    def test_structure(self):
+        spec = dragonfly(4, 4, hosts_per_router=2, global_links=1)
+        assert spec.n_switches == 16
+        assert spec.n_hosts == 32
+        no_duplicate_ports(spec)
+
+    def test_global_degree_bound(self):
+        g, a, h = 8, 32, 2
+        spec = dragonfly(g, a, hosts_per_router=1, global_links=h)
+        assert spec.n_switches == 256
+        intra = g * (a * (a - 1) // 2)
+        deg = degree_counts(spec)
+        # global degree per router <= h
+        global_links = spec.links[intra:]
+        gdeg = {}
+        for x, _, y, _ in global_links:
+            gdeg[x] = gdeg.get(x, 0) + 1
+            gdeg[y] = gdeg.get(y, 0) + 1
+        assert max(gdeg.values()) <= h
+
+    def test_connected_small_diameter(self):
+        spec = dragonfly(8, 32, hosts_per_router=1, global_links=2)
+        db = spec.to_topology_db(backend="jax")
+        from sdnmpi_tpu.oracle.engine import tensorize
+        from sdnmpi_tpu.oracle.apsp import apsp_distances
+
+        t = tensorize(db)
+        dist = np.asarray(apsp_distances(t.adj))
+        real = dist[: t.n_real, : t.n_real]
+        assert np.isfinite(real).all()
+        assert real.max() <= 5  # local-global-local worst case with detours
+
+    def test_too_few_globals_rejected(self):
+        with pytest.raises(ValueError):
+            dragonfly(16, 2, global_links=1)  # a*h=2 < g-1=15
+
+
+class TestBasic:
+    def test_linear(self):
+        spec = linear(4)
+        assert len(spec.links) == 3
+        no_duplicate_ports(spec)
+
+    def test_ring(self):
+        spec = ring(5)
+        assert len(spec.links) == 5
+        no_duplicate_ports(spec)
+
+    def test_torus(self):
+        spec = torus2d(3, 3)
+        assert spec.n_switches == 9
+        assert len(spec.links) == 18
+        no_duplicate_ports(spec)
+        db = spec.to_topology_db(backend="jax")
+        from sdnmpi_tpu.oracle.engine import tensorize
+        from sdnmpi_tpu.oracle.apsp import apsp_distances
+
+        t = tensorize(db)
+        dist = np.asarray(apsp_distances(t.adj))
+        assert dist[: t.n_real, : t.n_real].max() == 2  # 3x3 torus diameter
+
+    def test_fabric_materialization(self):
+        spec = linear(3)
+        fabric = spec.to_fabric()
+        assert sorted(fabric.switches) == [1, 2, 3]
+        assert len(fabric.hosts) == 3
