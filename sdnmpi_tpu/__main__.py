@@ -1,0 +1,3 @@
+from sdnmpi_tpu.launch import main
+
+main()

@@ -1,0 +1,7 @@
+from sdnmpi_tpu.api.rpc import RPCInterface  # noqa: F401
+from sdnmpi_tpu.api.snapshot import (  # noqa: F401
+    snapshot_controller,
+    restore_controller,
+    save_checkpoint,
+    load_checkpoint,
+)

@@ -1,0 +1,166 @@
+"""Northbound state mirror: WebSocket JSON-RPC.
+
+Equivalent of the reference's ``RPCInterface``
+(reference: sdnmpi/rpc_interface.py:18-110): on client connect, pushes
+full snapshots as ``init_fdb`` / ``init_rankdb`` / ``init_topologydb``
+(obtained through the same three Current* requests), then re-broadcasts
+every state-change event as a JSON-RPC call with the reference's exact
+method names and positional params:
+
+    add_process(rank, mac)        delete_process(rank)
+    update_fdb(dpid, src, dst, port)
+    add_switch(switch_dict)       delete_switch(switch_dict)
+    add_link(link_dict)           delete_link(link_dict)
+    add_host(host_dict)
+
+plus ``remove_fdb(dpid, src, dst)`` for the flow teardowns the reference
+never performs. Calls are JSON-RPC 2.0 *notifications* (no ids — the
+reference's tinyrpc stack sent ids but ignored the replies,
+rpc_interface.py:74-85).
+
+Transport is split from logic for testability: the app broadcasts to any
+object with a ``send_json(dict)`` method; ``serve()`` runs the real
+asyncio WebSocket endpoint at the reference's path (/v1.0/sdnmpi/ws) and
+drops clients whose sockets fail, as the reference does on SocketError
+(rpc_interface.py:87-95).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Protocol
+
+from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.bus import EventBus
+
+log = logging.getLogger("RPCInterface")
+
+
+class RPCClient(Protocol):
+    def send_json(self, message: dict) -> None: ...
+
+
+class RPCInterface:
+    name = "RPCInterface"
+
+    def __init__(self, bus: EventBus, config: Config = DEFAULT_CONFIG) -> None:
+        self.bus = bus
+        self.config = config
+        self.clients: list[RPCClient] = []
+
+        bus.subscribe(ev.EventProcessAdd, lambda e: self._broadcast("add_process", e.rank, e.mac))
+        bus.subscribe(ev.EventProcessDelete, lambda e: self._broadcast("delete_process", e.rank))
+        bus.subscribe(ev.EventFDBUpdate, lambda e: self._broadcast("update_fdb", e.dpid, e.src, e.dst, e.port))
+        bus.subscribe(ev.EventFDBRemove, lambda e: self._broadcast("remove_fdb", e.dpid, e.src, e.dst))
+        bus.subscribe(ev.EventSwitchEnter, lambda e: self._broadcast("add_switch", _to_dict(e.switch)))
+        bus.subscribe(ev.EventSwitchLeave, lambda e: self._broadcast("delete_switch", _to_dict(e.switch)))
+        bus.subscribe(ev.EventLinkAdd, lambda e: self._broadcast("add_link", _to_dict(e.link)))
+        bus.subscribe(ev.EventLinkDelete, lambda e: self._broadcast("delete_link", _to_dict(e.link)))
+        bus.subscribe(ev.EventHostAdd, lambda e: self._broadcast("add_host", _to_dict(e.host)))
+
+    # -- client lifecycle -------------------------------------------------
+
+    def init_client(self, client: RPCClient) -> None:
+        """Push full state snapshots to a newly-connected client
+        (reference: rpc_interface.py:34-40)."""
+        fdb = self.bus.request(ev.CurrentFDBRequest()).fdb
+        self._call(client, "init_fdb", fdb.to_dict())
+        rankdb = self.bus.request(ev.CurrentProcessAllocationRequest()).processes
+        self._call(client, "init_rankdb", rankdb.to_dict())
+        topology = self.bus.request(ev.CurrentTopologyRequest()).topology
+        self._call(client, "init_topologydb", topology.to_dict())
+
+    def attach_client(self, client: RPCClient) -> None:
+        self.clients.append(client)
+        self.init_client(client)
+
+    def detach_client(self, client: RPCClient) -> None:
+        if client in self.clients:
+            self.clients.remove(client)
+
+    # -- broadcasting -----------------------------------------------------
+
+    def _call(self, client: RPCClient, method: str, *params) -> bool:
+        try:
+            client.send_json(
+                {"jsonrpc": "2.0", "method": method, "params": list(params)}
+            )
+            return True
+        except Exception:
+            log.debug("RPC client failed on %s; dropping", method, exc_info=True)
+            return False
+
+    def _broadcast(self, method: str, *params) -> None:
+        dead = [c for c in self.clients if not self._call(c, method, *params)]
+        for client in dead:
+            self.clients.remove(client)
+
+    # -- real transport ---------------------------------------------------
+
+    async def serve(self):
+        """Run the WebSocket endpoint until cancelled."""
+        import asyncio
+
+        import websockets
+
+        interface = self
+
+        async def handler(ws):
+            path = getattr(getattr(ws, "request", None), "path", None)
+            if path is not None and path != interface.config.rpc_path:
+                await ws.close(code=1008, reason="unknown path")
+                return
+            loop = asyncio.get_running_loop()
+            client = _WebSocketClient(ws, loop)
+            interface.attach_client(client)
+            log.info("RPC client connected")
+            try:
+                await client.pump()
+            finally:
+                interface.detach_client(client)
+                log.info("RPC client disconnected")
+
+        async with websockets.serve(
+            handler, self.config.rpc_host, self.config.rpc_port
+        ):
+            log.info(
+                "RPC mirror listening on ws://%s:%s%s",
+                self.config.rpc_host,
+                self.config.rpc_port,
+                self.config.rpc_path,
+            )
+            await asyncio.Future()  # run until cancelled
+
+
+class _WebSocketClient:
+    """Bridges the synchronous bus to one async WebSocket connection via
+    an outbound queue (the bus thread is the event-loop thread)."""
+
+    def __init__(self, ws, loop) -> None:
+        import asyncio
+
+        self.ws = ws
+        self.loop = loop
+        self.queue: "asyncio.Queue[str]" = asyncio.Queue()
+        self.closed = False
+
+    def send_json(self, message: dict) -> None:
+        if self.closed:
+            raise ConnectionError("websocket closed")
+        self.queue.put_nowait(json.dumps(message))
+
+    async def pump(self) -> None:
+        try:
+            while True:
+                await self.ws.send(await self.queue.get())
+        except Exception:
+            self.closed = True
+            raise
+
+
+def _to_dict(entity) -> dict:
+    from sdnmpi_tpu.core.topology_db import _entity_dict
+
+    return _entity_dict(entity)

@@ -1,0 +1,77 @@
+"""Controller checkpoint / resume.
+
+The reference keeps all state in memory and rebuilds only via
+rediscovery after a restart (SURVEY §5: "checkpoint/resume: none"); its
+``to_dict`` serializers exist purely to seed WebSocket clients. Here the
+same serializers double as a checkpoint format: ``snapshot_controller``
+captures topology, installed flows, the rank registry, and link
+utilization; ``restore_controller`` rebuilds the stores so a restarted
+controller resumes with warm state instead of a blank network view.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
+
+SNAPSHOT_VERSION = 1
+
+
+def snapshot_controller(controller) -> dict:
+    return {
+        "version": SNAPSHOT_VERSION,
+        "topology": controller.topology_manager.topologydb.to_dict(),
+        "fdb": controller.router.fdb.to_dict(),
+        "rankdb": controller.process_manager.rankdb.to_dict(),
+        "link_util": [
+            [dpid, port, bps]
+            for (dpid, port), bps in controller.topology_manager.link_util.items()
+        ],
+    }
+
+
+def restore_controller(controller, snapshot: dict) -> None:
+    if snapshot.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version {snapshot.get('version')}")
+
+    db = controller.topology_manager.topologydb
+    topo = snapshot["topology"]
+    for sw in topo["switches"]:
+        db.add_switch(
+            Switch.make(
+                sw["dpid"],
+                [Port(p["dpid"], p["port_no"]) for p in sw.get("ports", [])],
+            )
+        )
+    for link in topo["links"]:
+        db.add_link(Link(_port(link["src"]), _port(link["dst"])))
+    for host in topo["hosts"]:
+        db.add_host(Host(host["mac"], _port(host["port"])))
+
+    fdb = controller.router.fdb
+    for dpid_str, table in snapshot["fdb"].items():
+        for pair, port in table.items():
+            src, dst = pair.split(" ")
+            fdb.update(int(dpid_str), src, dst, port)
+
+    rankdb = controller.process_manager.rankdb
+    for rank_str, mac in snapshot["rankdb"].items():
+        rankdb.add_process(int(rank_str), mac)
+
+    controller.topology_manager.link_util.update(
+        {(dpid, port): bps for dpid, port, bps in snapshot.get("link_util", [])}
+    )
+
+
+def _port(d: dict) -> Port:
+    return Port(d["dpid"], d["port_no"])
+
+
+def save_checkpoint(controller, path: str | pathlib.Path) -> None:
+    pathlib.Path(path).write_text(json.dumps(snapshot_controller(controller)))
+
+
+def load_checkpoint(controller, path: str | pathlib.Path) -> None:
+    restore_controller(controller, json.loads(pathlib.Path(path).read_text()))

@@ -47,6 +47,12 @@ class Config:
     max_path_len: int = 32
     #: weight of link utilization when scoring congestion-aware routes
     congestion_alpha: float = 1.0
+    #: nominal link capacity used to normalize the Monitor's bps samples
+    #: into flow-equivalent units before they enter the balancer's score
+    link_capacity_bps: float = 10e9
+    #: how many parallel sub-flows an aggregated (edge, edge) switch pair
+    #: is split into for ECMP spreading in balanced batch routing
+    ecmp_ways: int = 4
     #: when an MPI packet of a known collective arrives, pre-route and
     #: install flows for EVERY rank pair of that collective in one
     #: load-balanced oracle batch (the north-star behavior; the reference

@@ -189,15 +189,18 @@ class Router:
             return
         # Pattern generators work in index space 0..n-1; registered rank
         # ids need not be contiguous, so map through the sorted rank list.
-        # Root heuristic: the kickoff packet of a rooted collective is the
-        # root's first send (BCAST/SCATTER: root transmits) or a send
-        # toward the root (REDUCE/GATHER: root receives). A mid-collective
-        # first sighting can mis-root the tree — that only costs some
-        # unused proactive flows; the real pairs still route reactively.
+        # Root inference from the kickoff packet: BCAST/SCATTER round 0 is
+        # the root's own first send (src == root); GATHER is flat, so
+        # every packet's dst is the root. Binomial REDUCE cannot be
+        # inferred — its first round is n/2 parallel sends with different
+        # destinations, so a wrong guess is (n-2)/n likely; REDUCE
+        # therefore routes reactively instead of installing a mis-rooted
+        # tree.
+        if vmac.coll_type == CollectiveType.REDUCE:
+            return
         root_rank = {
             CollectiveType.BCAST: vmac.src_rank,
             CollectiveType.SCATTER: vmac.src_rank,
-            CollectiveType.REDUCE: vmac.dst_rank,
             CollectiveType.GATHER: vmac.dst_rank,
         }.get(vmac.coll_type)
         kwargs = {}
@@ -214,6 +217,7 @@ class Router:
         # sorted registered ranks, and the vMACs carry the *actual* ids
         todo: list[tuple[str, str, str]] = []  # (src_mac, pair_vmac, true_dst)
         pairs: list[tuple[str, str]] = []
+        installed = self.fdb.pairs()  # one scan, O(1) lookups in the loop
         for si, di in sorted({(int(s), int(d)) for s, d in rank_pairs}):
             if si == di:
                 continue
@@ -223,7 +227,7 @@ class Router:
             if not src_mac or not dst_mac:
                 continue
             pair_vmac = VirtualMac(vmac.coll_type, s_rank, d_rank).encode()
-            if self.fdb.exists_anywhere(src_mac, pair_vmac):
+            if (src_mac, pair_vmac) in installed:
                 continue
             todo.append((src_mac, pair_vmac, dst_mac))
             pairs.append((src_mac, dst_mac))

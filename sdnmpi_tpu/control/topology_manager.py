@@ -120,6 +120,8 @@ class TopologyManager:
                 link_util=self.link_util,
                 alpha=self.config.congestion_alpha,
                 chunk=self.config.ecmp_chunk,
+                link_capacity=self.config.link_capacity_bps,
+                ecmp_ways=self.config.ecmp_ways,
             )
             return ev.FindRoutesBatchReply(fdbs, max_congestion)
         return ev.FindRoutesBatchReply(self.topologydb.find_routes_batch(req.pairs))

@@ -43,6 +43,13 @@ class SwitchFDB:
         """True if any switch has a flow for this (src, dst) pair."""
         return any((src, dst) in table for table in self.fdb.values())
 
+    def pairs(self) -> set[tuple[str, str]]:
+        """All (src, dst) pairs with at least one installed flow."""
+        out: set[tuple[str, str]] = set()
+        for table in self.fdb.values():
+            out.update(table)
+        return out
+
     def entries(self) -> Iterator[tuple[int, str, str, int]]:
         for dpid, table in self.fdb.items():
             for (src, dst), port in table.items():

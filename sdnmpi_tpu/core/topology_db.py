@@ -245,6 +245,8 @@ class TopologyDB:
         link_util: Optional[dict[tuple[int, int], float]] = None,
         alpha: float = 1.0,
         chunk: int = 4096,
+        link_capacity: float = 10e9,
+        ecmp_ways: int = 4,
     ) -> tuple[list[list[tuple[int, int]]], float]:
         """Load-aware batched routing: the whole batch is spread across
         equal-cost paths on device, seeded with measured link utilization
@@ -255,7 +257,7 @@ class TopologyDB:
         """
         if self.backend == "jax":
             return self._jax_oracle().routes_batch_balanced(
-                self, pairs, link_util, alpha, chunk
+                self, pairs, link_util, alpha, chunk, link_capacity, ecmp_ways
             )
         fdbs = [self.find_route(s, d) for s, d in pairs]
         load: dict[tuple[int, int], float] = {}

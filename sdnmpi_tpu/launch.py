@@ -1,0 +1,177 @@
+"""Controller launcher — the CLI face of the framework.
+
+Equivalent of the reference's launch scripts
+(reference: run_router.sh / run_router_debug.sh / run_router_no_monitor.sh,
+which select Ryu apps and logging configs): three profiles map 1:1 —
+
+    normal      INFO logging, monitor on          (run_router.sh)
+    debug       DEBUG logging, monitor on         (run_router_debug.sh)
+    no-monitor  INFO logging, monitor off         (run_router_no_monitor.sh)
+
+The monitor's TSV stream goes to ``log/monitor.log`` like the reference's
+logging.ini routes the Monitor logger (logging.ini:16-29); everything
+else goes to stderr.
+
+Since the southbound is the simulated fabric, the launcher also owns
+topology construction (``--topo linear:4``, ``fattree:8``,
+``dragonfly:8,32``, ``torus:4,4``) and an optional ``--demo`` traffic
+generator that registers MPI ranks and fires a collective through the
+fabric so a connected visualizer has something to watch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import pathlib
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.topogen import dragonfly, fattree, host_mac, linear, ring, torus2d
+
+log = logging.getLogger("launch")
+
+
+def parse_topo(spec: str):
+    kind, _, args = spec.partition(":")
+    nums = [int(x) for x in args.split(",") if x] if args else []
+    if kind == "linear":
+        return linear(*(nums or [4]))
+    if kind == "ring":
+        return ring(*(nums or [4]))
+    if kind == "fattree":
+        return fattree(*(nums or [4]))
+    if kind == "dragonfly":
+        return dragonfly(*(nums or [4, 4]))
+    if kind == "torus":
+        return torus2d(*(nums or [4, 4]))
+    raise SystemExit(f"unknown topology {spec!r}")
+
+
+def setup_logging(profile: str, log_dir: str = "log") -> None:
+    level = logging.DEBUG if profile == "debug" else logging.INFO
+    logging.basicConfig(
+        level=level, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    # split the Monitor TSV stream into its own file, like logging.ini
+    pathlib.Path(log_dir).mkdir(exist_ok=True)
+    monitor_logger = logging.getLogger("Monitor")
+    handler = logging.FileHandler(pathlib.Path(log_dir) / "monitor.log")
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    monitor_logger.addHandler(handler)
+    monitor_logger.propagate = False
+
+
+def run_demo(controller: Controller, fabric, n_ranks: int) -> None:
+    """Register ranks and fire an alltoall so there is state to mirror."""
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+    from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+    n = min(n_ranks, len(fabric.hosts))
+    for rank in range(n):
+        mac = host_mac(rank)
+        fabric.hosts[mac].send(
+            of.Packet(
+                eth_src=mac,
+                eth_dst="ff:ff:ff:ff:ff:ff",
+                eth_type=of.ETH_TYPE_IP,
+                ip_proto=of.IPPROTO_UDP,
+                udp_dst=controller.config.announcement_port,
+                payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+            )
+        )
+    vmac = VirtualMac(CollectiveType.ALLTOALL, 0, 1 % n).encode()
+    fabric.hosts[host_mac(0)].send(
+        of.Packet(eth_src=host_mac(0), eth_dst=vmac, eth_type=of.ETH_TYPE_IP)
+    )
+    flows = sum(len(t) for t in controller.router.fdb.fdb.values())
+    log.info("demo: %d ranks, alltoall kicked off, %d flows installed", n, flows)
+
+
+async def amain(args) -> None:
+    config = Config(
+        oracle_backend=args.backend,
+        enable_monitor=args.profile != "no-monitor",
+        rpc_host=args.rpc_host,
+        rpc_port=args.rpc_port,
+    )
+    spec = parse_topo(args.topo)
+    fabric = spec.to_fabric()
+    controller = Controller(fabric, config)
+
+    if args.restore:
+        from sdnmpi_tpu.api.snapshot import load_checkpoint
+
+        load_checkpoint(controller, args.restore)
+        log.info("restored checkpoint from %s", args.restore)
+
+    controller.attach()
+    log.info(
+        "topology %s: %d switches, %d hosts",
+        spec.name,
+        spec.n_switches,
+        spec.n_hosts,
+    )
+
+    tasks = []
+    if controller.monitor is not None:
+        tasks.append(asyncio.create_task(controller.monitor.run()))
+    if not args.no_rpc:
+        from sdnmpi_tpu.api.rpc import RPCInterface
+
+        rpc = RPCInterface(controller.bus, config)
+        tasks.append(asyncio.create_task(rpc.serve()))
+
+    if args.demo:
+        run_demo(controller, fabric, args.demo_ranks)
+
+    try:
+        if args.duration > 0:
+            await asyncio.sleep(args.duration)
+        else:
+            await asyncio.Future()
+    except asyncio.CancelledError:
+        pass
+    finally:
+        if args.checkpoint:
+            from sdnmpi_tpu.api.snapshot import save_checkpoint
+
+            save_checkpoint(controller, args.checkpoint)
+            log.info("checkpoint written to %s", args.checkpoint)
+        for task in tasks:
+            task.cancel()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="sdnmpi_tpu", description="TPU-native SDN-MPI controller"
+    )
+    parser.add_argument(
+        "--profile",
+        choices=["normal", "debug", "no-monitor"],
+        default="normal",
+        help="launch profile (mirrors the reference's run_router*.sh)",
+    )
+    parser.add_argument("--topo", default="linear:4", help="topology spec, e.g. fattree:8")
+    parser.add_argument("--backend", choices=["jax", "py"], default="jax")
+    parser.add_argument("--rpc-host", default="127.0.0.1")
+    parser.add_argument("--rpc-port", type=int, default=8080)
+    parser.add_argument("--no-rpc", action="store_true", help="disable the WebSocket mirror")
+    parser.add_argument("--demo", action="store_true", help="generate demo MPI traffic")
+    parser.add_argument("--demo-ranks", type=int, default=8)
+    parser.add_argument("--duration", type=float, default=0, help="run time in seconds (0 = forever)")
+    parser.add_argument("--checkpoint", help="write a state checkpoint on shutdown")
+    parser.add_argument("--restore", help="restore state from a checkpoint file")
+    args = parser.parse_args(argv)
+
+    setup_logging(args.profile)
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -259,12 +259,23 @@ class RouteOracle:
         link_util: Optional[dict[tuple[int, int], float]] = None,
         alpha: float = 1.0,
         chunk: int = 4096,
+        link_capacity: float = 10e9,
+        ecmp_ways: int = 4,
     ) -> tuple[list[list[tuple[int, int]]], float]:
         """Load-aware batch routing (oracle/congestion.py): spreads the
         batch across equal-cost paths, seeded with measured utilization.
 
         Returns (fdbs, max_congestion). Unlike ``routes_batch`` the chosen
         paths depend on the whole batch, not just the endpoints.
+
+        Scalability: pairs sharing an (edge switch, edge switch) transit
+        are aggregated, then split into up to ``ecmp_ways`` weighted
+        sub-flows so the balancer can still spread them over parallel
+        paths — a 4096-rank alltoall becomes ~edge^2 * ways device flows,
+        not 16.7M. Measured utilization is normalized from bps to
+        flow-equivalent units (fraction of ``link_capacity`` times the
+        batch's average per-link share) so a hot link steers the balancer
+        without overriding it outright.
         """
         from sdnmpi_tpu.oracle.congestion import (
             route_flows_balanced,
@@ -277,36 +288,60 @@ class RouteOracle:
         if not rows:
             return results, 0.0
 
-        src_idx = np.array([r[1] for r in rows], dtype=np.int32)
-        dst_idx = np.array([r[2] for r in rows], dtype=np.int32)
+        # aggregate by transit pair, split into ECMP sub-flows
+        groups: dict[tuple[int, int], list[tuple[int, int]]] = {}
+        for k, si, di, final_port in rows:
+            groups.setdefault((si, di), []).append((k, final_port))
+
+        sub_src: list[int] = []
+        sub_dst: list[int] = []
+        sub_w: list[float] = []
+        group_subs: dict[tuple[int, int], tuple[int, int]] = {}  # -> (first, n)
+        for (si, di), members in groups.items():
+            nsub = max(1, min(ecmp_ways, len(members)))
+            group_subs[(si, di)] = (len(sub_src), nsub)
+            for _ in range(nsub):
+                sub_src.append(si)
+                sub_dst.append(di)
+                sub_w.append(len(members) / nsub)
+
+        src_idx = np.array(sub_src, dtype=np.int32)
+        dst_idx = np.array(sub_dst, dtype=np.int32)
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
             return results, 0.0
 
-        base = utilization_matrix(t, link_util or {}) * alpha
+        util = utilization_matrix(t, link_util or {})
+        n_links = max(1, int((np.asarray(t.adj) > 0).sum()))
+        per_link_share = max(1.0, len(rows) / n_links)
+        base = (util / max(link_capacity, 1.0)) * alpha * per_link_share
+
         nodes, _, maxc = route_flows_balanced(
             t.adj,
             jnp.asarray(self._dist),
-            jnp.asarray(base),
+            jnp.asarray(base.astype(np.float32)),
             jnp.asarray(src_idx),
             jnp.asarray(dst_idx),
-            jnp.ones(len(rows), np.float32),
+            jnp.asarray(np.array(sub_w, dtype=np.float32)),
             max_len,
             chunk=chunk,
         )
         nodes = np.asarray(nodes)
         port_mat = np.asarray(t.port)
         dpids = t.dpids
-        for f, (k, _, _, final_port) in enumerate(rows):
-            path = nodes[f][nodes[f] >= 0]
-            if len(path) == 0:
-                continue
-            fdb = [
-                (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
-                for h in range(len(path) - 1)
-            ]
-            fdb.append((int(dpids[path[-1]]), final_port))
-            results[k] = fdb
+        for (si, di), members in groups.items():
+            first, nsub = group_subs[(si, di)]
+            for j, (k, final_port) in enumerate(members):
+                path = nodes[first + j % nsub]
+                path = path[path >= 0]
+                if len(path) == 0:
+                    continue
+                fdb = [
+                    (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
+                    for h in range(len(path) - 1)
+                ]
+                fdb.append((int(dpids[path[-1]]), final_port))
+                results[k] = fdb
         return results, float(maxc)
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------

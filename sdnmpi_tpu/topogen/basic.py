@@ -25,6 +25,9 @@ def linear(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
 
 def ring(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
     spec = linear(n_switches, hosts_per_switch)
+    spec.name = f"ring-{n_switches}"
+    if n_switches <= 2:
+        return spec  # the "wrap" link would duplicate the existing cable
     ports = PortAllocator()
     # continue numbering beyond already-used ports
     used = {}
@@ -35,7 +38,6 @@ def ring(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
         used[dpid] = max(used.get(dpid, 0), p)
     ports._next = {d: p + 1 for d, p in used.items()}
     spec.links.append((n_switches, ports.take(n_switches), 1, ports.take(1)))
-    spec.name = f"ring-{n_switches}"
     return spec
 
 
@@ -59,9 +61,12 @@ def torus2d(nx: int, ny: int, hosts_per_switch: int = 1) -> TopoSpec:
             a = dpid(x, y)
             right = dpid((x + 1) % nx, y)
             down = dpid(x, (y + 1) % ny)
-            if nx > 1:
+            # for a dimension of size 2 the wraparound would duplicate the
+            # neighbor cable (TopologyDB keys links by switch pair, so a
+            # second parallel cable is silently collapsed)
+            if nx > 1 and not (nx == 2 and x == 1):
                 links.append((a, ports.take(a), right, ports.take(right)))
-            if ny > 1:
+            if ny > 1 and not (ny == 2 and y == 1):
                 links.append((a, ports.take(a), down, ports.take(down)))
     return TopoSpec(f"torus-{nx}x{ny}", switches, links, hosts)
 

@@ -1,0 +1,191 @@
+"""RPC mirror and checkpoint/resume tests."""
+
+import asyncio
+import json
+
+import pytest
+
+from sdnmpi_tpu.api.rpc import RPCInterface
+from sdnmpi_tpu.api.snapshot import (
+    load_checkpoint,
+    restore_controller,
+    save_checkpoint,
+    snapshot_controller,
+)
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from tests.test_control import MAC, announce, ip_packet, make_diamond
+
+
+class FakeClient:
+    def __init__(self):
+        self.messages = []
+        self.dead = False
+
+    def send_json(self, message):
+        if self.dead:
+            raise ConnectionError("gone")
+        self.messages.append(message)
+
+    def methods(self):
+        return [m["method"] for m in self.messages]
+
+
+def make_stack(backend="py"):
+    fabric = make_diamond()
+    controller = Controller(fabric, Config(oracle_backend=backend))
+    rpc = RPCInterface(controller.bus, controller.config)
+    controller.attach()
+    return fabric, controller, rpc
+
+
+class TestRPCMirror:
+    def test_init_snapshots_on_attach(self):
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        # same init sequence as the reference (rpc_interface.py:34-40)
+        assert client.methods() == ["init_fdb", "init_rankdb", "init_topologydb"]
+        topo = client.messages[2]["params"][0]
+        assert len(topo["switches"]) == 4
+        assert len(topo["links"]) == 8
+        assert len(topo["hosts"]) == 4
+
+    def test_discovery_events_broadcast(self):
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        client.messages.clear()
+        fabric.add_switch(9)
+        fabric.add_link(1, 9, 9, 1)
+        assert client.methods() == ["add_switch", "add_link", "add_link"]
+
+    def test_process_and_fdb_events(self):
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        client.messages.clear()
+
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        assert client.methods() == ["add_process"]
+        assert client.messages[0]["params"] == [0, MAC[1]]
+
+        client.messages.clear()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[2]))
+        updates = [m for m in client.messages if m["method"] == "update_fdb"]
+        assert [tuple(m["params"]) for m in updates] == [
+            (1, MAC[1], MAC[2], 2),
+            (2, MAC[1], MAC[2], 1),
+        ]
+
+    def test_fdb_removal_mirrored(self):
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        client.messages.clear()
+        fabric.remove_link(2, 3, 4, 2)
+        assert "remove_fdb" in client.methods()
+        assert "delete_link" in client.methods()
+
+    def test_dead_client_dropped(self):
+        fabric, controller, rpc = make_stack()
+        alive, dead = FakeClient(), FakeClient()
+        rpc.attach_client(alive)
+        rpc.attach_client(dead)
+        dead.dead = True
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        assert dead not in rpc.clients
+        assert alive in rpc.clients
+        assert "add_process" in alive.methods()
+
+    def test_messages_are_json_serializable(self):
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[2]))
+        for message in client.messages:
+            json.dumps(message)  # must not raise
+
+
+class TestWebSocketTransport:
+    def test_real_websocket_roundtrip(self):
+        websockets = pytest.importorskip("websockets")
+
+        async def scenario():
+            fabric, controller, rpc = make_stack()
+            config = controller.config
+            server_task = asyncio.create_task(rpc.serve())
+            await asyncio.sleep(0.2)
+            uri = f"ws://{config.rpc_host}:{config.rpc_port}{config.rpc_path}"
+            messages = []
+            async with websockets.connect(uri) as ws:
+                # trigger an event after connect
+                await asyncio.sleep(0.1)
+                announce(fabric, MAC[1], AnnouncementType.LAUNCH, 3)
+                for _ in range(4):  # 3 init + 1 add_process
+                    messages.append(json.loads(await asyncio.wait_for(ws.recv(), 5)))
+            server_task.cancel()
+            return messages
+
+        messages = asyncio.run(scenario())
+        assert [m["method"] for m in messages] == [
+            "init_fdb",
+            "init_rankdb",
+            "init_topologydb",
+            "add_process",
+        ]
+        assert messages[3]["params"] == [3, MAC[1]]
+
+
+class TestCheckpoint:
+    def _populated(self):
+        fabric, controller, rpc = make_stack()
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[4], AnnouncementType.LAUNCH, 1)
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        controller.monitor.poll(now=0.0)
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        controller.monitor.poll(now=1.0)
+        return fabric, controller
+
+    def test_snapshot_restore_roundtrip(self):
+        fabric, controller = self._populated()
+        snap = snapshot_controller(controller)
+        json.dumps(snap)  # serializable
+
+        # fresh controller on an empty fabric standin
+        from sdnmpi_tpu.control.fabric import Fabric
+
+        fresh = Controller(Fabric(), Config(oracle_backend="py"))
+        restore_controller(fresh, snap)
+
+        db = fresh.topology_manager.topologydb
+        assert sorted(db.switches) == [1, 2, 3, 4]
+        assert len(db.hosts) == 4
+        # routing works from restored state alone
+        assert db.find_route(MAC[1], MAC[4]) == [(1, 2), (2, 3), (4, 1)]
+        assert fresh.process_manager.rankdb.get_mac(1) == MAC[4]
+        assert fresh.router.fdb.exists(1, MAC[1], MAC[4])
+        assert fresh.topology_manager.link_util == controller.topology_manager.link_util
+
+    def test_checkpoint_file_roundtrip(self, tmp_path):
+        fabric, controller = self._populated()
+        path = tmp_path / "ckpt.json"
+        save_checkpoint(controller, path)
+
+        from sdnmpi_tpu.control.fabric import Fabric
+
+        fresh = Controller(Fabric(), Config(oracle_backend="py"))
+        load_checkpoint(fresh, path)
+        assert fresh.process_manager.rankdb.ranks() == [0, 1]
+
+    def test_version_check(self):
+        from sdnmpi_tpu.control.fabric import Fabric
+
+        fresh = Controller(Fabric(), Config(oracle_backend="py"))
+        with pytest.raises(ValueError):
+            restore_controller(fresh, {"version": 99})
