@@ -138,18 +138,28 @@ class _WebSocketClient:
     """Bridges the synchronous bus to one async WebSocket connection via
     an outbound queue (the bus thread is the event-loop thread)."""
 
+    #: outbound backlog bound: a stalled client that stops reading gets
+    #: dropped instead of buffering the controller's event stream forever
+    MAX_BACKLOG = 4096
+
     def __init__(self, ws, loop) -> None:
         import asyncio
 
         self.ws = ws
         self.loop = loop
-        self.queue: "asyncio.Queue[str]" = asyncio.Queue()
+        self.queue: "asyncio.Queue[str]" = asyncio.Queue(maxsize=self.MAX_BACKLOG)
         self.closed = False
 
     def send_json(self, message: dict) -> None:
+        import asyncio
+
         if self.closed:
             raise ConnectionError("websocket closed")
-        self.queue.put_nowait(json.dumps(message))
+        try:
+            self.queue.put_nowait(json.dumps(message))
+        except asyncio.QueueFull:
+            self.closed = True
+            raise ConnectionError("websocket client stalled; backlog full")
 
     async def pump(self) -> None:
         try:

@@ -50,12 +50,6 @@ def restore_controller(controller, snapshot: dict) -> None:
     for host in topo["hosts"]:
         db.add_host(Host(host["mac"], _port(host["port"])))
 
-    fdb = controller.router.fdb
-    for dpid_str, table in snapshot["fdb"].items():
-        for pair, port in table.items():
-            src, dst = pair.split(" ")
-            fdb.update(int(dpid_str), src, dst, port)
-
     rankdb = controller.process_manager.rankdb
     for rank_str, mac in snapshot["rankdb"].items():
         rankdb.add_process(int(rank_str), mac)
@@ -63,6 +57,20 @@ def restore_controller(controller, snapshot: dict) -> None:
     controller.topology_manager.link_util.update(
         {(dpid, port): bps for dpid, port, bps in snapshot.get("link_util", [])}
     )
+
+    # Flows are restored by *re-routing* the snapshotted (src, dst) pairs
+    # and pushing real FlowMods to whatever datapaths are currently live —
+    # seeding the bookkeeping alone would dedup-suppress installs forever
+    # while the switches sit empty. Restore after attach() so the
+    # datapaths are connected.
+    pairs = sorted(
+        {
+            tuple(pair.split(" "))
+            for table in snapshot["fdb"].values()
+            for pair in table
+        }
+    )
+    controller.router.reinstall_pairs([(s, d) for s, d in pairs])
 
 
 def _port(d: dict) -> Port:

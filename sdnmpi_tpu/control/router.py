@@ -322,6 +322,26 @@ class Router:
             if dpid in self.dps:
                 self._del_flow(dpid, src, dst)
 
+    def reinstall_pairs(self, pairs: list[tuple[str, str]]) -> None:
+        """Re-route and install flows for (src, dst) match pairs — used by
+        checkpoint restore, where only the pair set is trusted: paths are
+        recomputed against the current topology and pushed to the live
+        switches, so bookkeeping and switch state stay coherent."""
+        resolved: list[tuple[str, str, str]] = []
+        for src, dst in pairs:
+            effective = self._effective_dst(dst)
+            if effective:
+                resolved.append((src, dst, effective))
+        if not resolved:
+            return
+        fdbs = self.bus.request(
+            ev.FindRoutesBatchRequest([(s, e) for s, _, e in resolved])
+        ).fdbs
+        for (src, dst, effective), fdb in zip(resolved, fdbs):
+            if fdb:
+                true_dst = effective if is_sdn_mpi_addr(dst) else None
+                self._add_flows_for_path(fdb, src, dst, true_dst)
+
     # -- snapshots --------------------------------------------------------
 
     def _current_fdb(self, req: ev.CurrentFDBRequest) -> ev.CurrentFDBReply:

@@ -70,6 +70,9 @@ def run_demo(controller: Controller, fabric, n_ranks: int) -> None:
     from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
 
     n = min(n_ranks, len(fabric.hosts))
+    if n < 2:
+        log.warning("demo needs at least 2 ranks (have %d); skipping", n)
+        return
     for rank in range(n):
         mac = host_mac(rank)
         fabric.hosts[mac].send(
@@ -100,14 +103,13 @@ async def amain(args) -> None:
     spec = parse_topo(args.topo)
     fabric = spec.to_fabric()
     controller = Controller(fabric, config)
+    controller.attach()
 
     if args.restore:
         from sdnmpi_tpu.api.snapshot import load_checkpoint
 
         load_checkpoint(controller, args.restore)
         log.info("restored checkpoint from %s", args.restore)
-
-    controller.attach()
     log.info(
         "topology %s: %d switches, %d hosts",
         spec.name,

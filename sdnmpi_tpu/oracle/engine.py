@@ -43,6 +43,9 @@ class TopoTensors:
     adj: jnp.ndarray  # [V, V] f32 0/1, directed
     port: jnp.ndarray  # [V, V] int32, out-port i -> j, -1 if no link
     n_real: int
+    #: max out-degree, rounded up to a multiple of 8 (static bound for the
+    #: balancer's compact neighbor table)
+    max_degree: int = 32
 
     @property
     def v(self) -> int:
@@ -77,12 +80,14 @@ def tensorize(db: "TopologyDB", pad_multiple: int = 8) -> TopoTensors:
             adj[i, j] = 1.0
             port[i, j] = link.src.port_no
 
+    out_degree = int((adj > 0).sum(axis=1).max()) if len(dpids) else 0
     return TopoTensors(
         dpids=dpids,
         index=index,
         adj=jnp.asarray(adj),
         port=jnp.asarray(port),
         n_real=len(dpids),
+        max_degree=max(8, ((out_degree + 7) // 8) * 8),
     )
 
 
@@ -325,6 +330,7 @@ class RouteOracle:
             jnp.asarray(np.array(sub_w, dtype=np.float32)),
             max_len,
             chunk=chunk,
+            max_degree=t.max_degree,
         )
         nodes = np.asarray(nodes)
         port_mat = np.asarray(t.port)

@@ -1,0 +1,6 @@
+from sdnmpi_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    apsp_distances_sharded,
+    route_flows_sharded,
+    multichip_route_step,
+)

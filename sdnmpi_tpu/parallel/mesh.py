@@ -1,0 +1,165 @@
+"""Multi-chip sharding of the path oracle.
+
+The reference's scale axis is topology size x flow count, handled by one
+Python thread (SURVEY §5 "long-context" analogue). Here the oracle shards
+across a ``jax.sharding.Mesh`` with two axes:
+
+- ``"v"`` (model-parallel-like): the ``[V, V]`` BFS/APSP state is
+  row-sharded — each device expands the frontier for its own block of
+  source switches with a local ``[V/s, V] @ [V, V]`` matmul. No
+  cross-device traffic inside the loop; XLA all-gathers the distance
+  blocks once afterward.
+- ``"flow"`` (data-parallel-like): a collective's flow batch is sharded;
+  each device greedily load-balances its shard, then the per-shard link
+  loads are ``psum``-ed into the global load/congestion figures.
+
+``multichip_route_step`` composes both under one ``jit`` — this is the
+"full training step" the driver dry-runs over N virtual devices, and the
+same code lays out work on a real multi-chip TPU slice where the psum
+rides the ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sdnmpi_tpu.oracle.apsp import INF
+from sdnmpi_tpu.oracle.congestion import route_flows_balanced
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    """Mesh over the first n devices: axes ("flow", "v"). With 4+ devices
+    both axes are non-trivial (n/2 x 2); fewer devices degenerate to
+    (n, 1)."""
+    devices = jax.devices()[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+    if n_devices >= 4 and n_devices % 2 == 0:
+        shape = (n_devices // 2, 2)
+    else:
+        shape = (n_devices, 1)
+    return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
+
+
+def apsp_distances_sharded(adj: jax.Array, mesh: Mesh) -> jax.Array:
+    """Row-sharded BFS APSP: sources split across the "v" axis.
+
+    Functionally identical to oracle.apsp.apsp_distances; each shard runs
+    its own convergence loop (no collectives inside), so iteration count
+    is its local eccentricity bound.
+    """
+    v = adj.shape[0]
+    n_shards = mesh.shape["v"]
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by v-axis size {n_shards}")
+
+    eye = jnp.eye(v, dtype=jnp.float32)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P("v", None)),
+        out_specs=P("v", None),
+        check_vma=False,  # per-shard while_loop trip counts legitimately vary
+    )
+    def block_bfs(a, reached0):
+
+        a = (a > 0).astype(jnp.float32)
+        dist0 = jnp.where(reached0 > 0, 0.0, INF)
+
+        def cond(carry):
+            _, _, t, changed = carry
+            return changed & (t <= v)
+
+        def body(carry):
+            reached, dist, t, _ = carry
+            grown = jnp.minimum(reached @ a + reached, 1.0)
+            newly = (grown > 0) & jnp.isinf(dist)
+            dist = jnp.where(newly, t.astype(jnp.float32), dist)
+            return grown, dist, t + 1, jnp.any(newly)
+
+        _, dist, _, _ = lax.while_loop(
+            cond, body, (reached0, dist0, jnp.int32(1), jnp.bool_(True))
+        )
+        return dist
+
+    return block_bfs(adj, eye)
+
+
+def route_flows_sharded(
+    adj: jax.Array,
+    dist: jax.Array,
+    base_cost: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    weight: jax.Array,
+    mesh: Mesh,
+    max_len: int,
+    chunk: int = 1024,
+    max_degree: int = 32,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Flow batch sharded over the "flow" axis; every device balances its
+    shard locally (greedy scan, oracle/congestion.py) and the link loads
+    are psum-ed into the global congestion picture."""
+    u = src.shape[0]
+    n_shards = mesh.shape["flow"] * mesh.shape["v"]
+    if u % n_shards:
+        raise ValueError(f"flow count {u} must divide by {n_shards} shards")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, None),
+            P(None, None),
+            P(None, None),
+            P(("flow", "v")),
+            P(("flow", "v")),
+            P(("flow", "v")),
+        ),
+        out_specs=(P(("flow", "v")), P(None, None), P(None, None)),
+        check_vma=False,  # psum output is replicated by construction
+    )
+    def inner(a, d, base, s, t, w):
+        nodes, load, _ = route_flows_balanced(
+            a, d, base, s, t, w, max_len, chunk=chunk, max_degree=max_degree
+        )
+        load = lax.psum(load, ("flow", "v"))
+        maxc = jnp.max(jnp.where(a > 0, load, 0.0))
+        return nodes, load, maxc[None, None]
+
+    nodes, load, maxc = inner(adj, dist, base_cost, src, dst, weight)
+    return nodes, load, maxc[0, 0]
+
+
+def multichip_route_step(
+    adj: jax.Array,
+    base_cost: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    weight: jax.Array,
+    mesh: Mesh,
+    max_len: int,
+    chunk: int = 1024,
+    max_degree: int = 32,
+):
+    """The full sharded oracle step under one jit: row-sharded APSP, an
+    implicit all-gather of the distance blocks, then flow-sharded
+    balanced routing with psum-ed congestion."""
+
+    @jax.jit
+    def step(adj, base_cost, src, dst, weight):
+        dist = apsp_distances_sharded(adj, mesh)
+        return route_flows_sharded(
+            adj, dist, base_cost, src, dst, weight, mesh, max_len, chunk,
+            max_degree,
+        )
+
+    return step(adj, base_cost, src, dst, weight)

@@ -2,14 +2,22 @@
 
 Tests run on the JAX CPU backend with 8 virtual devices so that the
 multi-chip sharding paths (parallel/) are exercised without TPU hardware.
-The env vars must be set before jax is first imported.
+
+This environment pins JAX_PLATFORMS=axon (a TPU tunnel) and imports jax
+during interpreter startup via sitecustomize, so setting env vars here is
+too late — the platform must be forced through jax.config before any
+backend is instantiated. XLA_FLAGS is still read at CPU-client creation,
+which happens later, so the env var works for the device count.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -116,8 +116,19 @@ class TestWebSocketTransport:
         websockets = pytest.importorskip("websockets")
 
         async def scenario():
-            fabric, controller, rpc = make_stack()
-            config = controller.config
+            import socket
+
+            # grab an ephemeral port so parallel runs don't collide
+            probe = socket.socket()
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+
+            fabric = make_diamond()
+            config = Config(oracle_backend="py", rpc_port=port)
+            controller = Controller(fabric, config)
+            rpc = RPCInterface(controller.bus, config)
+            controller.attach()
             server_task = asyncio.create_task(rpc.serve())
             await asyncio.sleep(0.2)
             uri = f"ws://{config.rpc_host}:{config.rpc_port}{config.rpc_path}"
@@ -157,10 +168,10 @@ class TestCheckpoint:
         snap = snapshot_controller(controller)
         json.dumps(snap)  # serializable
 
-        # fresh controller on an empty fabric standin
-        from sdnmpi_tpu.control.fabric import Fabric
-
-        fresh = Controller(Fabric(), Config(oracle_backend="py"))
+        # a restarted controller on a fresh fabric of the same shape
+        fresh_fabric = make_diamond()
+        fresh = Controller(fresh_fabric, Config(oracle_backend="py"))
+        fresh.attach()
         restore_controller(fresh, snap)
 
         db = fresh.topology_manager.topologydb
@@ -171,15 +182,28 @@ class TestCheckpoint:
         assert fresh.process_manager.rankdb.get_mac(1) == MAC[4]
         assert fresh.router.fdb.exists(1, MAC[1], MAC[4])
         assert fresh.topology_manager.link_util == controller.topology_manager.link_util
+        # flows were actually pushed to the new switches, not just recorded
+        # (seeding bookkeeping alone would dedup-suppress installs forever)
+        assert any(
+            e.match.dl_src == MAC[1] and e.match.dl_dst == MAC[4]
+            for e in fresh_fabric.switches[1].flow_table
+        )
+        # and traffic forwards without touching the controller
+        from sdnmpi_tpu.control import events as ev
+
+        seen = []
+        fresh.bus.subscribe(ev.EventPacketIn, lambda e: seen.append(e))
+        fresh_fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+        assert len(fresh_fabric.hosts[MAC[4]].received) == 1
+        assert seen == []
 
     def test_checkpoint_file_roundtrip(self, tmp_path):
         fabric, controller = self._populated()
         path = tmp_path / "ckpt.json"
         save_checkpoint(controller, path)
 
-        from sdnmpi_tpu.control.fabric import Fabric
-
-        fresh = Controller(Fabric(), Config(oracle_backend="py"))
+        fresh = Controller(make_diamond(), Config(oracle_backend="py"))
+        fresh.attach()
         load_checkpoint(fresh, path)
         assert fresh.process_manager.rankdb.ranks() == [0, 1]
 
@@ -189,3 +213,21 @@ class TestCheckpoint:
         fresh = Controller(Fabric(), Config(oracle_backend="py"))
         with pytest.raises(ValueError):
             restore_controller(fresh, {"version": 99})
+
+    def test_stalled_rpc_client_dropped_on_backlog(self):
+        from sdnmpi_tpu.api.rpc import _WebSocketClient
+
+        class Loop:
+            pass
+
+        client = _WebSocketClient.__new__(_WebSocketClient)
+        import asyncio
+
+        client.ws = None
+        client.queue = asyncio.Queue(maxsize=2)
+        client.closed = False
+        client.send_json({"a": 1})
+        client.send_json({"a": 2})
+        with pytest.raises(ConnectionError):
+            client.send_json({"a": 3})
+        assert client.closed
