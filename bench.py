@@ -1,15 +1,28 @@
 """Flagship benchmark: BASELINE.md config 4.
 
-Routes a 4096-rank MPI_Alltoall over a 1024-switch three-level fat-tree
-(k=28 -> 980 real switches, padded to V=1024) on one TPU chip, end to
-end per iteration:
+Routes a stream of 4096-rank MPI_Alltoall collectives over a 1024-switch
+three-level fat-tree (k=28 -> 980 real switches, padded to V=1024) on
+one TPU chip. Each collective is one device program
+(oracle/dag.route_collective):
 
-  1. upload fresh per-link utilization (host -> device),
+  1. fresh per-link utilization upload (compact [E] vector, not [V, V]),
   2. all-pairs BFS distances for the whole fabric (boolean-matmul BFS),
   3. load-balanced ECMP routing of the full collective — 16.7M rank
-     pairs aggregated to ~86k edge-switch pairs split into weighted ECMP
-     sub-flows — with the max-link-congestion metric,
-  4. read the chosen hop matrix back to the host.
+     pairs aggregated to ~86k edge-switch pairs — via level-decomposed
+     shortest-path-DAG flow propagation (pure [V, V] matmuls on the MXU)
+     with iterative congestion reweighting,
+  4. per-pair discrete path sampling from the converged split weights,
+  5. readback of every chosen route as compact int8 neighbor-slot
+     sequences + the max-link-congestion metric, in ONE packed buffer.
+
+The measured number is the steady-state per-collective wall time of a
+pipelined stream: dispatches are issued back-to-back and every result is
+fetched by a small reader pool, so readback of collective i overlaps the
+device computing collective i+1 — exactly how the controller consumes
+the oracle (routes for one collective are installed while the next is
+being computed). Compile time is excluded; the timed window dispatches
+AND fully materializes M collectives on the host, so per-collective
+time = wall / M with nothing left in flight.
 
 The reference computes one route per packet-in with a Python DFS
 (reference: sdnmpi/util/topology_db.py:59-84, ~O(V+E) per pair x 16.7M
@@ -25,6 +38,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -32,10 +46,10 @@ N_RANKS = 4096
 FATTREE_K = 28  # 980 switches -> padded to 1024
 V_PAD = 1024
 TARGET_MS = 50.0
-ECMP_WAYS = 4
-CHUNK = 32768  # per-step work is [CHUNK, degree] — big chunks are cheap
-MAX_LEN = 5  # fat-tree switch diameter is 4 -> paths have <= 5 nodes
-ITERS = 5
+ROUNDS = 2  # congestion-reweighting rounds
+READERS = 4  # host reader threads overlapping readback with compute
+N_WARM = 3
+N_MEAS = 16
 
 
 def log(msg: str) -> None:
@@ -43,6 +57,7 @@ def log(msg: str) -> None:
 
 
 def build_problem():
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
     from sdnmpi_tpu.oracle.congestion import aggregate_pairs
     from sdnmpi_tpu.oracle.engine import tensorize
     from sdnmpi_tpu.topogen import fattree
@@ -63,85 +78,108 @@ def build_problem():
     )
     # alltoall traffic matrix aggregated by (src_edge, dst_edge): the
     # per-pair weight is ranks_on_src_edge x ranks_on_dst_edge, which
-    # aggregate_pairs computes from the full 16.7M pair expansion more
-    # cheaply via counting
+    # aggregate_pairs computes from the full 16.7M pair expansion via
+    # counting
     src_sw = np.repeat(host_edge, N_RANKS)
     dst_sw = np.tile(host_edge, N_RANKS)
     keep = src_sw != dst_sw  # same-edge pairs place no transit load
     usrc, udst, weight = aggregate_pairs(src_sw[keep], dst_sw[keep])
-
-    # split each aggregated pair into ECMP sub-flows
-    usrc = np.repeat(usrc, ECMP_WAYS)
-    udst = np.repeat(udst, ECMP_WAYS)
-    weight = np.repeat(weight / ECMP_WAYS, ECMP_WAYS).astype(np.float32)
     log(
         f"alltoall: {N_RANKS} ranks = {int(keep.sum()):,} rank pairs -> "
-        f"{len(usrc) // ECMP_WAYS:,} edge pairs x {ECMP_WAYS} ECMP sub-flows "
-        f"= {len(usrc):,} device flows"
+        f"{len(usrc):,} aggregated edge-switch flows"
     )
-    return t, usrc, udst, weight
+
+    v = t.adj.shape[0]
+    adj_host = np.asarray(t.adj)
+    li, lj = np.nonzero(adj_host > 0)
+    traffic = np.zeros((v, v), np.float32)
+    traffic[udst, usrc] = weight
+
+    dist_host = np.asarray(apsp_distances(t.adj))
+    levels = int(np.nanmax(np.where(np.isfinite(dist_host), dist_host, np.nan)))
+    log(f"{len(li):,} directed links, diameter {levels}")
+    return t, li.astype(np.int32), lj.astype(np.int32), traffic, usrc, udst, weight, levels
 
 
 def main() -> None:
     import jax
 
-    from sdnmpi_tpu.oracle.apsp import apsp_distances
-    from sdnmpi_tpu.oracle.congestion import route_flows_balanced
+    from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
 
     log(f"devices: {jax.devices()}")
-    t, src, dst, weight = build_problem()
+    t, li, lj, traffic, src, dst, weight, levels = build_problem()
     v = t.adj.shape[0]
+    n_flows = len(src)
+    max_len = levels + 1
     rng = np.random.default_rng(0)
 
+    li_d = jax.device_put(li)
+    lj_d = jax.device_put(lj)
+    traffic_d = jax.device_put(traffic)
     src_d = jax.device_put(src)
     dst_d = jax.device_put(dst)
-    w_d = jax.device_put(weight)
 
-    def one_iteration(util_host: np.ndarray) -> tuple[float, float]:
-        start = time.perf_counter()
-        base = jax.device_put(util_host)  # utilization upload
-        dist = apsp_distances(t.adj)  # full APSP, fresh
-        nodes, _, maxc = route_flows_balanced(
-            t.adj, dist, base, src_d, dst_d, w_d, MAX_LEN,
-            chunk=CHUNK, max_degree=t.max_degree,
+    def dispatch(i: int):
+        util = (rng.random(len(li)) * 0.1).astype(np.float32)
+        buf = route_collective(
+            t.adj, li_d, lj_d, jax.device_put(util), traffic_d, src_d, dst_d,
+            levels=levels, rounds=ROUNDS, max_len=max_len,
+            max_degree=t.max_degree,
         )
-        hops = np.asarray(nodes)  # route readback
-        congestion = float(maxc)
-        elapsed = (time.perf_counter() - start) * 1e3
-        assert hops.shape == (len(src), MAX_LEN)
-        return elapsed, congestion
+        try:
+            buf.copy_to_host_async()
+        except Exception:
+            pass
+        return buf
 
-    # warmup / compile
-    util = (rng.random((v, v)) * 0.1).astype(np.float32)
+    # compile + warmup
     t0 = time.perf_counter()
-    one_iteration(util)
+    first = np.asarray(dispatch(0))
     log(f"compile+first run: {time.perf_counter() - t0:.1f}s")
+    slots0, maxc0 = unpack_result(first, n_flows, max_len)
+    for i in range(N_WARM):
+        np.asarray(dispatch(i + 1))
 
-    times, congs = [], []
-    for i in range(ITERS):
-        util = (rng.random((v, v)) * 0.1).astype(np.float32)
-        ms, congestion = one_iteration(util)
-        times.append(ms)
-        congs.append(congestion)
-        log(f"iter {i}: {ms:.2f} ms, max link congestion {congestion:,.0f}")
+    pool = ThreadPoolExecutor(READERS)
+    t0 = time.perf_counter()
+    futures = [pool.submit(np.asarray, dispatch(100 + i)) for i in range(N_MEAS)]
+    hosts = [f.result() for f in futures]
+    elapsed = time.perf_counter() - t0
+    congs = [unpack_result(h, n_flows, max_len)[1] for h in hosts]
+    value = elapsed / N_MEAS * 1e3
+    log(f"steady-state: {N_MEAS} collectives in {elapsed * 1e3:.1f} ms "
+        f"-> {value:.2f} ms per collective ({READERS} reader threads)")
 
-    value = float(np.median(times))
+    # validation + context (untimed): decode every route, recompute the
+    # exact discrete link loads, compare against naive single-path routing
+    nodes = slots_to_nodes(np.asarray(t.adj), src, slots0, dst)
+    ok = nodes[:, 0] == src
+    assert ok.all(), "every aggregated flow must start at its source"
+    load = np.zeros((v, v), np.float32)
+    for h in range(max_len - 1):
+        a, b = nodes[:, h], nodes[:, h + 1]
+        sel = (a >= 0) & (b >= 0)
+        np.add.at(load, (a[sel], b[sel]), weight[sel])
+    discrete_max = float(load.max())
 
-    # context: what does naive single-shortest-path routing concentrate?
-    from sdnmpi_tpu.oracle.apsp import apsp_next_hops
-    from sdnmpi_tpu.oracle.congestion import link_loads_from_paths
+    from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
     from sdnmpi_tpu.oracle.paths import batch_paths
 
     dist = apsp_distances(t.adj)
     nxt = apsp_next_hops(t.adj, dist)
-    naive_nodes, _ = batch_paths(nxt, src_d, dst_d, MAX_LEN)
-    naive_max = float(
-        np.max(np.asarray(link_loads_from_paths(naive_nodes, v, w_d)))
-    )
+    naive_nodes, _ = batch_paths(nxt, src_d, dst_d, max_len)
+    naive_nodes = np.asarray(naive_nodes)
+    naive_load = np.zeros((v, v), np.float32)
+    for h in range(max_len - 1):
+        a, b = naive_nodes[:, h], naive_nodes[:, h + 1]
+        sel = (a >= 0) & (b >= 0)
+        np.add.at(naive_load, (a[sel], b[sel]), weight[sel])
+    naive_max = float(naive_load.max())
     log(
-        f"max link congestion: balanced {np.median(congs):,.0f} vs "
+        f"max link congestion: balanced {discrete_max:,.0f} discrete "
+        f"(fractional bound {np.median([maxc0] + congs):,.0f}) vs "
         f"deterministic single-path {naive_max:,.0f} "
-        f"({naive_max / max(np.median(congs), 1):.2f}x better)"
+        f"({naive_max / max(discrete_max, 1):.2f}x better)"
     )
 
     print(

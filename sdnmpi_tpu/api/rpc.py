@@ -159,6 +159,12 @@ class _WebSocketClient:
             self.queue.put_nowait(json.dumps(message))
         except asyncio.QueueFull:
             self.closed = True
+            # actually tear the connection down: pump() is blocked in
+            # ws.send() on backpressure and only a close unblocks it so
+            # the handler can release the socket and the full queue
+            self.loop.call_soon_threadsafe(
+                lambda: self.loop.create_task(self.ws.close())
+            )
             raise ConnectionError("websocket client stalled; backlog full")
 
     async def pump(self) -> None:

@@ -36,17 +36,24 @@ def restore_controller(controller, snapshot: dict) -> None:
     if snapshot.get("version") != SNAPSHOT_VERSION:
         raise ValueError(f"unsupported snapshot version {snapshot.get('version')}")
 
+    # Live discovery is authoritative for topology: once attach() has
+    # populated any switches, merging the snapshot would resurrect links
+    # that no longer exist (no delete event ever fires for a link that
+    # was never discovered) and routes could blackhole through them. The
+    # snapshot topology is only a cold-start warm cache; discovery
+    # upserts over it as real events arrive.
     db = controller.topology_manager.topologydb
     topo = snapshot["topology"]
-    for sw in topo["switches"]:
-        db.add_switch(
-            Switch.make(
-                sw["dpid"],
-                [Port(p["dpid"], p["port_no"]) for p in sw.get("ports", [])],
+    if not db.switches:
+        for sw in topo["switches"]:
+            db.add_switch(
+                Switch.make(
+                    sw["dpid"],
+                    [Port(p["dpid"], p["port_no"]) for p in sw.get("ports", [])],
+                )
             )
-        )
-    for link in topo["links"]:
-        db.add_link(Link(_port(link["src"]), _port(link["dst"])))
+        for link in topo["links"]:
+            db.add_link(Link(_port(link["src"]), _port(link["dst"])))
     for host in topo["hosts"]:
         db.add_host(Host(host["mac"], _port(host["port"])))
 

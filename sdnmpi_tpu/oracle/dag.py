@@ -1,0 +1,377 @@
+"""MXU-native load-balanced collective routing (oracle v3).
+
+The greedy balancer in oracle/congestion.py routes flows in sequential
+chunks with scatter-adds — exact, but the sequential scan and TPU
+scatter cost seconds at alltoall scale. This module reformulates
+load-aware ECMP so that **every step is a dense [V, V] matmul**, which
+is exactly what the MXU wants:
+
+- Traffic is a dense matrix ``F[t, i]`` — mass injected at switch ``i``
+  destined to switch ``t`` (an entire collective, aggregated per
+  edge-switch pair, is one such matrix).
+- Shortest-path-DAG flow propagation is decomposed **by BFS level**:
+  mass at distance ``l`` from its destination moves to distance
+  ``l - 1`` each step. Because level membership is a mask on the
+  distance matrix, one propagation step for *all destinations at once*
+  factorizes into three matmuls (normalizer, advance, link load):
+
+      Z    = M[l-1] @ W.T          # per-(t, i) split normalizer
+      out  = (G * M[l]) / Z
+      G'   = (out @ W) * M[l-1]    # mass arriving one level closer
+      load += W * (out.T @ M[l-1]) # per-link f32 load
+
+  where ``W`` is the congestion-weighted adjacency and ``M[l][t, i] =
+  (dist[i, t] == l)``. ``levels`` such steps route everything; with
+  V = 1024 and diameter 4 a full collective costs ~12 matmuls of
+  [1024, 1024] — microseconds of MXU time, no scatters at all.
+- Congestion awareness is iterative: after each round the link weights
+  are rescaled by the load the previous round produced
+  (``W = A / (1 + cost / mean_cost)``), so hot links shed flow. With
+  zero base cost round 1 is exact uniform ECMP splitting.
+- Discrete per-flow paths (the fdb the controller installs) are then
+  *sampled* from the converged split weights: each flow walks the DAG
+  choosing next hops by deterministic hash-weighted selection. This is
+  pure gathers, vmapped over flows — no inter-flow dependencies, no
+  scatters — and flows with equal weights split ~evenly by construction.
+
+The reference's multi-path machinery enumerates every equal-cost path on
+the CPU and can't use the result (reference: sdnmpi/util/topology_db.py:
+86-122 and the dead FindAllRoutes API, sdnmpi/topology.py:37-48,144-148);
+this is the working, device-native replacement.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INF = jnp.inf
+
+
+def propagate_levels(
+    weights: jax.Array,  # [V, V] f32 congestion-weighted adjacency (0 = no link)
+    dist_t: jax.Array,  # [T, V] f32: dist_t[t, i] = hop count i -> t
+    traffic: jax.Array,  # [T, V] f32: mass injected at i destined t
+    levels: int,
+) -> jax.Array:
+    """Push all traffic down the shortest-path DAG; return [V, V] link load.
+
+    Mass splits at each node across its one-step-closer neighbors in
+    proportion to ``weights``. ``levels`` must be >= the largest finite
+    distance carrying traffic; farther pairs simply never move (their
+    mass is dropped, matching "unreachable").
+    """
+    load = jnp.zeros_like(weights)
+    g = traffic
+    for l in range(levels, 0, -1):
+        lvl = jnp.float32(l)
+        m_cur = (dist_t == lvl).astype(jnp.float32)  # [T, V]
+        m_nxt = (dist_t == lvl - 1.0).astype(jnp.float32)
+        cur = g * m_cur
+        z = m_nxt @ weights.T  # [T, V]: sum of candidate weights per (t, i)
+        out = jnp.where(z > 0.0, cur / jnp.maximum(z, 1e-30), 0.0)
+        g = g * (1.0 - m_cur) + (out @ weights) * m_nxt
+        load = load + weights * (out.T @ m_nxt)
+    return load
+
+
+def congestion_weights(
+    adj_f: jax.Array, cost: jax.Array
+) -> jax.Array:
+    """Scale-free inverse-cost link weights: ``A / (1 + cost / mean)``.
+
+    The mean is taken over real links so the weighting is invariant to
+    the units of ``cost`` (bps, flow counts, ...). Zero cost everywhere
+    -> uniform weights -> exact even ECMP splits.
+    """
+    n_links = jnp.maximum(jnp.sum(adj_f), 1.0)
+    c0 = jnp.sum(cost * adj_f) / n_links
+    return adj_f / (1.0 + cost / jnp.maximum(c0, 1e-30))
+
+
+def balance_rounds(
+    adj: jax.Array,  # [V, V] 0/1
+    dist: jax.Array,  # [V, V] f32, dist[i, t]
+    base_cost: jax.Array,  # [V, V] f32 measured utilization
+    traffic: jax.Array,  # [T, V] f32 (T == V), traffic[t, i]
+    levels: int,
+    rounds: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Iteratively reweighted DAG routing.
+
+    Returns (weights [V, V], load [V, V], max_congestion scalar) from the
+    final round. Round 1 splits by base cost only (uniform when idle);
+    each later round folds the previous round's own load back into the
+    cost, shifting flow off the links the collective itself saturated.
+    """
+    adj_f = (adj > 0).astype(jnp.float32)
+    dist_t = dist.T
+    cost = base_cost
+    weights = congestion_weights(adj_f, cost)
+    load = propagate_levels(weights, dist_t, traffic, levels)
+    for _ in range(rounds - 1):
+        cost = base_cost + load
+        weights = congestion_weights(adj_f, cost)
+        load = propagate_levels(weights, dist_t, traffic, levels)
+    maxc = jnp.max(load)
+    return weights, load, maxc
+
+
+def _hash_u32(x: jax.Array) -> jax.Array:
+    """Cheap 32-bit integer mix (xorshift-multiply) for per-flow salts."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def sample_paths(
+    weights: jax.Array,  # [V, V] f32 split weights (0 = no link)
+    dist: jax.Array,  # [V, V] f32
+    src: jax.Array,  # [F] int32 (-1 = padding)
+    dst: jax.Array,  # [F] int32
+    max_len: int,
+    max_degree: int,
+    salt: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Draw one concrete shortest path per flow from the split weights.
+
+    Returns (nodes [F, max_len] int32 switch sequence padded with -1,
+    slots [F, max_len] int8 neighbor-slot choices, -1 past the path end).
+    ``slots[f, h]`` indexes the sorted out-neighbor list of
+    ``nodes[f, h]`` — 5 bits instead of 32 per hop, so it is the compact
+    wire form for host readback; the host (or ``slots_to_nodes``)
+    reconstructs the dpid sequence with the same sorted-neighbor table.
+
+    Selection is a deterministic hash of (flow id, hop, salt) mapped to
+    the candidates' cumulative weights — flows sharing an (src, dst)
+    pair land on different equal-cost paths with the right frequencies,
+    with no sequential dependence between flows (pure gathers).
+    """
+    v = weights.shape[0]
+    d = min(max_degree, v)
+    idx = jnp.arange(v, dtype=jnp.int32)
+    neigh = jnp.sort(jnp.where(weights > 0.0, idx[None, :], v), axis=1)[:, :d]
+    neigh_valid = neigh < v
+    neigh_safe = jnp.minimum(neigh, v - 1)
+
+    dist_flat = dist.reshape(-1)
+    w_flat = weights.reshape(-1)
+    f = src.shape[0]
+    fid = jnp.arange(f, dtype=jnp.int32)
+    safe_dst = jnp.maximum(dst, 0)
+    alive0 = (src >= 0) & (dst >= 0)
+    alive0 &= jnp.isfinite(dist_flat[jnp.maximum(src, 0) * v + safe_dst])
+
+    def hop(carry, h):
+        node = carry
+        safe_node = jnp.maximum(node, 0)
+        moving = (node >= 0) & (node != dst)
+
+        nbrs = neigh_safe[safe_node]  # [F, D]
+        nval = neigh_valid[safe_node]
+        dcur = dist_flat[safe_node * v + safe_dst]
+        dn = dist_flat[nbrs * v + safe_dst[:, None]]
+        wc = jnp.where(
+            nval & (dn == dcur[:, None] - 1.0),
+            w_flat[safe_node[:, None] * v + nbrs],
+            0.0,
+        )
+        cum = jnp.cumsum(wc, axis=1)
+        total = cum[:, -1]
+        r = _hash_u32(
+            fid * jnp.uint32(2654435761)
+            + jnp.uint32(h) * jnp.uint32(0x9E3779B1)
+            + jnp.uint32(salt)
+        )
+        thresh = (r.astype(jnp.float32) / 4294967296.0) * total
+        slot = jnp.argmax(cum > thresh[:, None], axis=1).astype(jnp.int32)
+        nxt = jnp.take_along_axis(nbrs, slot[:, None], axis=1)[:, 0]
+
+        nxt = jnp.where(moving & (total > 0.0), nxt, -1)
+        slot = jnp.where(moving & (total > 0.0), slot, -1)
+        return nxt, (node, slot.astype(jnp.int8))
+
+    node0 = jnp.where(alive0, src, -1)
+    _, (nodes, slots) = lax.scan(hop, node0, jnp.arange(max_len))
+    return jnp.swapaxes(nodes, 0, 1), jnp.swapaxes(slots, 0, 1)
+
+
+def sample_paths_dense(
+    weights: jax.Array,  # [V, V] f32 split weights (0 = no link)
+    dist: jax.Array,  # [V, V] f32
+    src: jax.Array,  # [F] int32 (-1 = padding)
+    dst: jax.Array,  # [F] int32
+    max_len: int,
+    salt: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """MXU formulation of ``sample_paths`` — same contract, no gathers.
+
+    The gather-based sampler spends ~6 cycles per randomly gathered
+    element (~200 ms for an alltoall batch); this version keeps every
+    per-flow quantity as a dense ``[F, V]`` row and turns the indexed
+    reads into one-hot matmuls the MXU executes in ~1 ms:
+
+    - ``dist_to_dst[f, :] = dist[:, dst_f]`` — ONE bf16 matmul
+      ``onehot(dst) @ dist.T`` for the whole collective, reused by every
+      hop (distances are small integers, exact in bf16).
+    - per hop, the current node's weight row is ``onehot(node) @ W``,
+      candidates are an elementwise mask, and the weighted choice uses
+      the Gumbel-max trick with hash-generated noise — an argmax instead
+      of a cumulative-sum search, so the whole hop is matmul +
+      elementwise + reduce, all MXU/VPU-friendly.
+
+    Returns (nodes [F, max_len] int32, slots [F, max_len] int8) exactly
+    like ``sample_paths`` (same slot numbering: rank of the chosen
+    neighbor among the node's sorted out-neighbors).
+    """
+    v = weights.shape[0]
+    f = src.shape[0]
+    w_bf = weights.astype(jnp.bfloat16)
+    # inf would produce 0 * inf = NaN under the one-hot matmul; 2^14 is
+    # exact in bf16 and larger than any real hop count
+    unreach = 16384.0
+    dist_bf = jnp.where(jnp.isfinite(dist), dist, unreach).T.astype(jnp.bfloat16)
+
+    safe_dst = jnp.maximum(dst, 0)
+    oh_dst = jax.nn.one_hot(safe_dst, v, dtype=jnp.bfloat16)  # [F, V]
+    d2t = (oh_dst @ dist_bf).astype(jnp.float32)  # [F, V] dist[j, dst_f]
+
+    iota = jnp.arange(v, dtype=jnp.int32)
+    fid = jnp.arange(f, dtype=jnp.uint32)
+    alive0 = (src >= 0) & (dst >= 0)
+    dsrc = jnp.take_along_axis(d2t, jnp.maximum(src, 0)[:, None], axis=1)[:, 0]
+    alive0 &= dsrc < unreach
+
+    def hop(node, h):
+        moving = (node >= 0) & (node != dst)
+        oh = jax.nn.one_hot(jnp.maximum(node, 0), v, dtype=jnp.bfloat16)
+        wrow = (oh @ w_bf).astype(jnp.float32)  # [F, V] weights out of node
+        arow = wrow > 0.0
+        dcur = jnp.take_along_axis(
+            d2t, jnp.maximum(node, 0)[:, None], axis=1
+        )  # [F, 1]
+        cand = arow & (d2t == dcur - 1.0)
+
+        # Gumbel-max: argmax(log w + g) samples j with prob w_j / sum w
+        hh = (h.astype(jnp.uint32) + 1) * jnp.uint32(0x9E3779B1) + jnp.uint32(
+            salt & 0xFFFFFFFF
+        )
+        u = _hash_u32(
+            (fid * jnp.uint32(2654435761))[:, None]
+            ^ (iota[None, :].astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+            ^ hh
+        )
+        un = (u.astype(jnp.float32) + 1.0) / 4294967296.0
+        gumbel = -jnp.log(-jnp.log(un))
+        score = jnp.where(cand, jnp.log(jnp.maximum(wrow, 1e-30)) + gumbel, -INF)
+        nxt = jnp.argmax(score, axis=1).astype(jnp.int32)
+        has = jnp.any(cand, axis=1)
+
+        # slot = rank of nxt among the node's sorted out-neighbors; the
+        # weight row is nonzero exactly on the adjacency row
+        slot = jnp.sum(
+            arow & (iota[None, :] < nxt[:, None]), axis=1
+        ).astype(jnp.int32)
+
+        ok = moving & has
+        nxt = jnp.where(ok, nxt, -1)
+        slot = jnp.where(ok, slot, -1)
+        return nxt, (node, slot.astype(jnp.int8))
+
+    node0 = jnp.where(alive0, src, -1)
+    _, (nodes, slots) = lax.scan(hop, node0, jnp.arange(max_len))
+    return jnp.swapaxes(nodes, 0, 1), jnp.swapaxes(slots, 0, 1)
+
+
+def slots_to_nodes(adj, src, slots, dst=None):
+    """Host-side decode of the compact slot form back to switch indices.
+
+    ``adj`` [V, V] array-like, ``src``/``dst`` [F] int32, ``slots``
+    [F, L] int8. Mirrors the device's sorted-neighbor table; returns
+    [F, L] int32 nodes padded with -1 (numpy, no device involved).
+    ``dst`` distinguishes a src==dst flow (path = [src]) from an
+    unreachable one (all -1) — both have an all--1 slot stream.
+    """
+    import numpy as np
+
+    a = np.asarray(adj) > 0
+    v = a.shape[0]
+    order = np.where(a, np.arange(v)[None, :], v)
+    order.sort(axis=1)
+    slots = np.asarray(slots, np.int32)
+    f, l = slots.shape
+    src = np.asarray(src, np.int32)
+    valid = (slots[:, 0] >= 0) | (src >= 0)
+    if dst is not None:
+        valid = (slots[:, 0] >= 0) | (src == np.asarray(dst, np.int32))
+    nodes = np.full((f, l), -1, np.int32)
+    node = np.where(valid, src, -1)
+    for h in range(l):
+        nodes[:, h] = node
+        s = slots[:, h]
+        ok = (s >= 0) & (node >= 0)
+        node = np.where(ok, order[np.maximum(node, 0), np.maximum(s, 0)], -1)
+    return nodes
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("levels", "rounds", "max_len", "max_degree", "salt"),
+)
+def route_collective(
+    adj: jax.Array,  # [V, V] 0/1
+    link_src: jax.Array,  # [E] int32 row index of each real link
+    link_dst: jax.Array,  # [E] int32 col index
+    link_util: jax.Array,  # [E] f32 measured utilization per link
+    traffic: jax.Array,  # [V, V] f32 traffic[t, i]
+    src: jax.Array,  # [F] int32 flow sources (-1 pad)
+    dst: jax.Array,  # [F] int32 flow destinations
+    levels: int,
+    rounds: int,
+    max_len: int,
+    max_degree: int,
+    salt: int = 0,
+) -> jax.Array:
+    """End-to-end collective routing, one device program, one output.
+
+    Scatters the compact per-link utilization vector into the [V, V]
+    cost matrix (unique indices — fast), runs APSP fresh, balances the
+    collective over the DAG, samples every flow's discrete path, and
+    packs ``slots`` (int8 [F * max_len]) + the bitcast f32 max-link
+    congestion into ONE int8 buffer so the host pays a single fetch.
+    """
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+
+    v = adj.shape[0]
+    base = (
+        jnp.zeros((v, v), jnp.float32)
+        .at[link_src, link_dst]
+        .set(link_util, unique_indices=True, mode="drop")
+    )
+    dist = apsp_distances(adj)
+    weights, _, maxc = balance_rounds(
+        adj, dist, base, traffic, levels=levels, rounds=rounds
+    )
+    _, slots = sample_paths_dense(weights, dist, src, dst, max_len, salt=salt)
+    tail = lax.bitcast_convert_type(maxc[None], jnp.int8).reshape(-1)
+    return jnp.concatenate([slots.reshape(-1), tail])
+
+
+def unpack_result(buf, n_flows: int, max_len: int):
+    """Host-side split of route_collective's packed buffer.
+
+    Returns (slots [F, max_len] int8 numpy, max_congestion float).
+    """
+    import numpy as np
+
+    host = np.asarray(buf)
+    slots = host[: n_flows * max_len].reshape(n_flows, max_len)
+    maxc = float(host[n_flows * max_len :].view(np.float32)[0])
+    return slots, maxc

@@ -215,15 +215,21 @@ class TestCheckpoint:
             restore_controller(fresh, {"version": 99})
 
     def test_stalled_rpc_client_dropped_on_backlog(self):
+        """Backlog overflow must mark the client closed AND schedule a
+        real socket close so the blocked pump() task gets unblocked."""
         from sdnmpi_tpu.api.rpc import _WebSocketClient
 
+        scheduled = []
+
         class Loop:
-            pass
+            def call_soon_threadsafe(self, cb):
+                scheduled.append(cb)
 
         client = _WebSocketClient.__new__(_WebSocketClient)
         import asyncio
 
         client.ws = None
+        client.loop = Loop()
         client.queue = asyncio.Queue(maxsize=2)
         client.closed = False
         client.send_json({"a": 1})
@@ -231,3 +237,4 @@ class TestCheckpoint:
         with pytest.raises(ConnectionError):
             client.send_json({"a": 3})
         assert client.closed
+        assert len(scheduled) == 1  # ws.close() teardown was requested

@@ -1,0 +1,190 @@
+"""Tests for the MXU-native DAG router (oracle/dag.py).
+
+Golden topology: the reference diamond (reference:
+tests/test_topologydb.py:14-61) — two equal-cost 2-hop paths 1->2->4 and
+1->3->4 — where uniform ECMP must split exactly 50/50.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.dag import (
+    balance_rounds,
+    propagate_levels,
+    route_collective,
+    sample_paths,
+    slots_to_nodes,
+    unpack_result,
+)
+from sdnmpi_tpu.oracle.engine import tensorize
+from tests.topo_fixtures import diamond
+
+
+@pytest.fixture(scope="module")
+def diamond_tensors():
+    t = tensorize(diamond(backend="jax"))
+    dist = apsp_distances(t.adj)
+    return t, dist
+
+
+def _traffic(v, entries):
+    """traffic[t, i] matrix from (src, dst, weight) triples."""
+    f = np.zeros((v, v), np.float32)
+    for s, d, w in entries:
+        f[d, s] += w
+    return jnp.asarray(f)
+
+
+class TestPropagation:
+    def test_even_ecmp_split_on_diamond(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        load = propagate_levels(adj_f, dist.T, _traffic(v, [(0, 3, 1.0)]), 2)
+        load = np.asarray(load)
+        # switch indices: dpid 1,2,3,4 -> 0,1,2,3
+        assert load[0, 1] == pytest.approx(0.5)
+        assert load[0, 2] == pytest.approx(0.5)
+        assert load[1, 3] == pytest.approx(0.5)
+        assert load[2, 3] == pytest.approx(0.5)
+        assert load.sum() == pytest.approx(2.0)  # 1 unit x 2 hops
+
+    def test_mass_conservation_per_hop(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        tr = _traffic(v, [(0, 3, 3.0), (1, 2, 2.0), (0, 1, 1.0)])
+        load = np.asarray(propagate_levels(adj_f, dist.T, tr, 4))
+        # total link load = sum over flows of weight * hop count
+        assert load.sum() == pytest.approx(3.0 * 2 + 2.0 * 2 + 1.0 * 1)
+
+    def test_unreachable_places_no_load(self):
+        db = diamond(backend="jax")
+        del db.links[1]  # cut switch 1 from 2 and 3 (reference-style)
+        del db.links[2][1]
+        del db.links[3][1]
+        t = tensorize(db)
+        dist = apsp_distances(t.adj)
+        v = t.adj.shape[0]
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        load = np.asarray(
+            propagate_levels(adj_f, dist.T, _traffic(v, [(0, 3, 1.0)]), 4)
+        )
+        assert load.sum() == pytest.approx(0.0)
+
+    def test_weighted_split_follows_weights(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        w = np.asarray((t.adj > 0).astype(jnp.float32)).copy()
+        w[0, 1] = 3.0  # 1->2 three times the weight of 1->3
+        load = np.asarray(
+            propagate_levels(jnp.asarray(w), dist.T, _traffic(v, [(0, 3, 4.0)]), 2)
+        )
+        assert load[0, 1] == pytest.approx(3.0)
+        assert load[0, 2] == pytest.approx(1.0)
+
+
+class TestBalanceRounds:
+    def test_hot_link_sheds_flow(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        base = np.zeros((v, v), np.float32)
+        base[0, 1] = 10.0  # measured congestion on 1->2
+        _, load, maxc = balance_rounds(
+            t.adj, dist, jnp.asarray(base), _traffic(v, [(0, 3, 1.0)]),
+            levels=2, rounds=2,
+        )
+        load = np.asarray(load)
+        assert load[0, 2] > load[0, 1]  # flow prefers the cold path
+        assert float(maxc) == pytest.approx(load.max())
+
+    def test_idle_network_stays_even(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        _, load, _ = balance_rounds(
+            t.adj, dist, jnp.zeros((v, v)), _traffic(v, [(0, 3, 1.0)]),
+            levels=2, rounds=3,
+        )
+        load = np.asarray(load)
+        assert load[0, 1] == pytest.approx(load[0, 2], rel=1e-5)
+
+
+class TestSamplePaths:
+    def test_paths_are_valid_shortest_paths(self, diamond_tensors):
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        src = jnp.asarray(np.zeros(64, np.int32))
+        dst = jnp.asarray(np.full(64, 3, np.int32))
+        nodes, slots = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        nodes = np.asarray(nodes)
+        adj = np.asarray(t.adj) > 0
+        for f in range(64):
+            path = nodes[f][nodes[f] >= 0]
+            assert path[0] == 0 and path[-1] == 3 and len(path) == 3
+            for a, b in zip(path, path[1:]):
+                assert adj[a, b]
+
+    def test_equal_weights_split_roughly_evenly(self, diamond_tensors):
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        n = 512
+        src = jnp.zeros(n, jnp.int32)
+        dst = jnp.full((n,), 3, jnp.int32)
+        nodes, _ = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        via2 = int((np.asarray(nodes)[:, 1] == 1).sum())
+        assert abs(via2 - n // 2) < n // 8  # within 12.5% of even
+
+    def test_deterministic(self, diamond_tensors):
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        src = jnp.zeros(16, jnp.int32)
+        dst = jnp.full((16,), 3, jnp.int32)
+        a, _ = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        b, _ = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_padding_and_unreachable_park(self, diamond_tensors):
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        src = jnp.asarray(np.array([-1, 0, 2], np.int32))
+        dst = jnp.asarray(np.array([3, -1, 2], np.int32))
+        nodes, slots = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        nodes = np.asarray(nodes)
+        assert (nodes[0] == -1).all() and (nodes[1] == -1).all()
+        assert nodes[2, 0] == 2 and (nodes[2, 1:] == -1).all()  # src == dst
+
+    def test_slots_roundtrip_to_nodes(self, diamond_tensors):
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        src = jnp.asarray(np.array([0, 1, 2, 3, 0, -1], np.int32))
+        dst = jnp.asarray(np.array([3, 2, 1, 3, 0, 0], np.int32))
+        nodes, slots = sample_paths(adj_f, dist, src, dst, 4, t.max_degree)
+        decoded = slots_to_nodes(t.adj, np.asarray(src), np.asarray(slots),
+                                 np.asarray(dst))
+        assert np.array_equal(decoded, np.asarray(nodes))
+
+
+class TestRouteCollective:
+    def test_end_to_end_packed(self, diamond_tensors):
+        t, dist = diamond_tensors
+        v = t.adj.shape[0]
+        adj = np.asarray(t.adj)
+        li, lj = np.nonzero(adj > 0)
+        util = np.zeros(len(li), np.float32)
+        src = np.array([0, 0, 1], np.int32)
+        dst = np.array([3, 3, 2], np.int32)
+        buf = route_collective(
+            t.adj, jnp.asarray(li.astype(np.int32)),
+            jnp.asarray(lj.astype(np.int32)), jnp.asarray(util),
+            _traffic(v, [(0, 3, 2.0), (1, 2, 1.0)]),
+            jnp.asarray(src), jnp.asarray(dst),
+            levels=2, rounds=2, max_len=4, max_degree=t.max_degree,
+        )
+        slots, maxc = unpack_result(buf, 3, 4)
+        nodes = slots_to_nodes(adj, src, slots, dst)
+        for f in range(3):
+            path = nodes[f][nodes[f] >= 0]
+            assert path[0] == src[f] and path[-1] == dst[f]
+        assert 0.0 < maxc <= 2.0
