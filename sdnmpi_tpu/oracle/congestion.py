@@ -87,7 +87,6 @@ def route_flows_balanced(
     callers with topology tensors pass it explicitly.
     """
     v = adj.shape[0]
-    d = min(max_degree, v)
     u = src.shape[0]
     n_chunks = -(-u // chunk)
     pad = n_chunks * chunk - u
@@ -97,13 +96,9 @@ def route_flows_balanced(
     flow_id = jnp.arange(n_chunks * chunk, dtype=jnp.int32)
 
     adj_mask = adj > 0
-    # compact neighbor table: sorted indices keep the lowest-dpid-first
-    # determinism; v marks an invalid slot
-    neigh = jnp.sort(
-        jnp.where(adj_mask, jnp.arange(v, dtype=jnp.int32)[None, :], v), axis=1
-    )[:, :d]
-    neigh_valid = neigh < v
-    neigh_safe = jnp.minimum(neigh, v - 1)
+    from sdnmpi_tpu.oracle.dag import neighbor_table
+
+    neigh, neigh_valid, neigh_safe = neighbor_table(adj, max_degree)
 
     dist_flat = dist.reshape(-1)
     base_flat = base_cost.reshape(-1)

@@ -120,6 +120,27 @@ def balance_rounds(
     return weights, load, maxc
 
 
+def neighbor_table(
+    adj_or_weights: jax.Array, max_degree: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Compact per-node out-neighbor table from a [V, V] matrix.
+
+    Returns ``(neigh, valid, safe)`` each ``[V, min(max_degree, V)]``:
+    sorted neighbor indices (lowest-dpid-first determinism), a validity
+    mask, and indices clamped to a safe gather range. Entries beyond a
+    node's out-degree are invalid. ``max_degree`` must be >= the true
+    max out-degree or neighbors are silently truncated — callers with
+    topology tensors pass ``TopoTensors.max_degree``.
+    """
+    v = adj_or_weights.shape[0]
+    d = min(max_degree, v)
+    idx = jnp.arange(v, dtype=jnp.int32)
+    neigh = jnp.sort(
+        jnp.where(adj_or_weights > 0, idx[None, :], v), axis=1
+    )[:, :d]
+    return neigh, neigh < v, jnp.minimum(neigh, v - 1)
+
+
 def _hash_u32(x: jax.Array) -> jax.Array:
     """Cheap 32-bit integer mix (xorshift-multiply) for per-flow salts."""
     x = x.astype(jnp.uint32)
@@ -155,11 +176,7 @@ def sample_paths(
     with no sequential dependence between flows (pure gathers).
     """
     v = weights.shape[0]
-    d = min(max_degree, v)
-    idx = jnp.arange(v, dtype=jnp.int32)
-    neigh = jnp.sort(jnp.where(weights > 0.0, idx[None, :], v), axis=1)[:, :d]
-    neigh_valid = neigh < v
-    neigh_safe = jnp.minimum(neigh, v - 1)
+    neigh, neigh_valid, neigh_safe = neighbor_table(weights, max_degree)
 
     dist_flat = dist.reshape(-1)
     w_flat = weights.reshape(-1)

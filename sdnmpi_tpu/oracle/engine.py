@@ -204,6 +204,86 @@ class RouteOracle:
             rows.append((k, si, di, port))
         return rows
 
+    @staticmethod
+    def _group_ecmp_subflows(
+        rows: list[tuple[int, int, int, int]], ecmp_ways: int
+    ) -> tuple[dict, dict, np.ndarray, np.ndarray, np.ndarray]:
+        """Aggregate resolved pairs by (src, dst) transit and split each
+        group into up to ``ecmp_ways`` weighted sub-flows. Sub-flows get
+        distinct device flow ids, hence distinct hash streams and
+        (usually) distinct equal-cost paths; members are dealt onto
+        sub-flows round-robin. Returns (groups, group_subs, src, dst,
+        weight) where ``group_subs[key] = (first sub-flow index, n)``."""
+        groups: dict[tuple[int, int], list[tuple[int, int]]] = {}
+        for k, si, di, final_port in rows:
+            groups.setdefault((si, di), []).append((k, final_port))
+        sub_src: list[int] = []
+        sub_dst: list[int] = []
+        sub_w: list[float] = []
+        group_subs: dict[tuple[int, int], tuple[int, int]] = {}
+        for key in sorted(groups):
+            members = groups[key]
+            nsub = max(1, min(ecmp_ways, len(members)))
+            group_subs[key] = (len(sub_src), nsub)
+            for _ in range(nsub):
+                sub_src.append(key[0])
+                sub_dst.append(key[1])
+                sub_w.append(len(members) / nsub)
+        return (
+            groups,
+            group_subs,
+            np.array(sub_src, dtype=np.int32),
+            np.array(sub_dst, dtype=np.int32),
+            np.array(sub_w, dtype=np.float32),
+        )
+
+    def _normalized_base(
+        self, t: TopoTensors, link_util, alpha: float, link_capacity: float,
+        n_rows: int,
+    ) -> np.ndarray:
+        """Normalize the Monitor's bps samples into flow-equivalent units
+        (fraction of link capacity x the batch's average per-link share)
+        so measured utilization and the balancer's own accumulated load
+        are comparable magnitudes in ``cost = base + load``."""
+        from sdnmpi_tpu.oracle.congestion import utilization_matrix
+
+        util = utilization_matrix(t, link_util or {})
+        n_links = max(1, int((np.asarray(t.adj) > 0).sum()))
+        per_link_share = max(1.0, n_rows / n_links)
+        return (util / max(link_capacity, 1.0)) * alpha * per_link_share
+
+    def _materialize_fdbs(
+        self,
+        t: TopoTensors,
+        groups: dict,
+        group_subs: dict,
+        paths: np.ndarray,
+        results: list,
+    ) -> list[tuple[int, int]]:
+        """Convert per-sub-flow node rows into installed fdbs.
+
+        ``paths`` is ``[n_subflows, L]`` int32 (-1 padded); each pair is
+        dealt onto its group's sub-flows round-robin. A path that does
+        not end at the pair's destination switch (truncated/unreachable)
+        is not installable and leaves the pair unrouted. Returns the
+        ``(pair index, sub-flow index)`` of every installed pair."""
+        port_mat = np.asarray(t.port)
+        dpids = t.dpids
+        installed: list[tuple[int, int]] = []
+        for key, members in groups.items():
+            first, nsub = group_subs[key]
+            for j, (k, final_port) in enumerate(members):
+                g = first + j % nsub
+                path = paths[g][paths[g] >= 0]
+                if len(path) == 0 or path[-1] != key[1]:
+                    continue
+                results[k] = [
+                    (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
+                    for h in range(len(path) - 1)
+                ] + [(int(dpids[path[-1]]), final_port)]
+                installed.append((k, g))
+        return installed
+
     def _batch_max_len(self, src_idx: np.ndarray, dst_idx: np.ndarray) -> int:
         """Hop budget covering the batch's true maximum distance (no
         reachable flow can be truncated), rounded up to a multiple of 8 to
@@ -282,10 +362,7 @@ class RouteOracle:
         batch's average per-link share) so a hot link steers the balancer
         without overriding it outright.
         """
-        from sdnmpi_tpu.oracle.congestion import (
-            route_flows_balanced,
-            utilization_matrix,
-        )
+        from sdnmpi_tpu.oracle.congestion import route_flows_balanced
 
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
@@ -293,33 +370,14 @@ class RouteOracle:
         if not rows:
             return results, 0.0
 
-        # aggregate by transit pair, split into ECMP sub-flows
-        groups: dict[tuple[int, int], list[tuple[int, int]]] = {}
-        for k, si, di, final_port in rows:
-            groups.setdefault((si, di), []).append((k, final_port))
-
-        sub_src: list[int] = []
-        sub_dst: list[int] = []
-        sub_w: list[float] = []
-        group_subs: dict[tuple[int, int], tuple[int, int]] = {}  # -> (first, n)
-        for (si, di), members in groups.items():
-            nsub = max(1, min(ecmp_ways, len(members)))
-            group_subs[(si, di)] = (len(sub_src), nsub)
-            for _ in range(nsub):
-                sub_src.append(si)
-                sub_dst.append(di)
-                sub_w.append(len(members) / nsub)
-
-        src_idx = np.array(sub_src, dtype=np.int32)
-        dst_idx = np.array(sub_dst, dtype=np.int32)
+        groups, group_subs, src_idx, dst_idx, sub_w = self._group_ecmp_subflows(
+            rows, ecmp_ways
+        )
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
             return results, 0.0
 
-        util = utilization_matrix(t, link_util or {})
-        n_links = max(1, int((np.asarray(t.adj) > 0).sum()))
-        per_link_share = max(1.0, len(rows) / n_links)
-        base = (util / max(link_capacity, 1.0)) * alpha * per_link_share
+        base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
 
         nodes, _, maxc = route_flows_balanced(
             t.adj,
@@ -327,28 +385,76 @@ class RouteOracle:
             jnp.asarray(base.astype(np.float32)),
             jnp.asarray(src_idx),
             jnp.asarray(dst_idx),
-            jnp.asarray(np.array(sub_w, dtype=np.float32)),
+            jnp.asarray(sub_w),
             max_len,
             chunk=chunk,
             max_degree=t.max_degree,
         )
-        nodes = np.asarray(nodes)
-        port_mat = np.asarray(t.port)
-        dpids = t.dpids
-        for (si, di), members in groups.items():
-            first, nsub = group_subs[(si, di)]
-            for j, (k, final_port) in enumerate(members):
-                path = nodes[first + j % nsub]
-                path = path[path >= 0]
-                if len(path) == 0:
-                    continue
-                fdb = [
-                    (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
-                    for h in range(len(path) - 1)
-                ]
-                fdb.append((int(dpids[path[-1]]), final_port))
-                results[k] = fdb
+        self._materialize_fdbs(t, groups, group_subs, np.asarray(nodes), results)
         return results, float(maxc)
+
+    def routes_batch_adaptive(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        ugal_candidates: int = 4,
+        ugal_bias: float = 1.0,
+        rounds: int = 2,
+        alpha: float = 1.0,
+        link_capacity: float = 10e9,
+        ecmp_ways: int = 4,
+    ) -> tuple[list[list[tuple[int, int]]], int]:
+        """UGAL adaptive min/non-min batch routing (oracle/adaptive.py).
+
+        Like :meth:`routes_batch_balanced` but each aggregated flow may
+        detour through a Valiant intermediate when measured congestion
+        makes its hop-minimal routes expensive — the right default on
+        low-diameter topologies (dragonfly). Pairs sharing an
+        (edge, edge) transit are split into up to ``ecmp_ways`` weighted
+        sub-flows (distinct hash streams -> distinct sampled paths), so
+        intra-group ECMP spreading is preserved alongside the UGAL
+        choice. Returns ``(fdbs, n_detoured_pairs)`` — the number of
+        input pairs whose installed route takes a Valiant detour.
+        """
+        from sdnmpi_tpu.oracle.adaptive import route_adaptive, stitch_paths
+
+        t = self.refresh(db)
+        results: list[list[tuple[int, int]]] = [[] for _ in pairs]
+        rows = self._resolve_rows(db, pairs, t, results)
+        if not rows:
+            return results, 0
+
+        groups, group_subs, src_idx, dst_idx, weight = self._group_ecmp_subflows(
+            rows, ecmp_ways
+        )
+        max_len = self._batch_max_len(src_idx, dst_idx)
+        if max_len == 0:
+            return results, 0
+        levels = max_len - 1
+
+        base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
+
+        inter, n1, n2, _ = route_adaptive(
+            t.adj,
+            jnp.asarray(base.astype(np.float32)),
+            jnp.asarray(src_idx),
+            jnp.asarray(dst_idx),
+            jnp.asarray(weight),
+            jnp.int32(t.n_real),
+            levels=levels,
+            rounds=rounds,
+            max_len=max_len,
+            n_candidates=ugal_candidates,
+            bias=ugal_bias,
+            max_degree=t.max_degree,
+            dist=jnp.asarray(self._dist),
+        )
+        paths = stitch_paths(n1, n2, inter)
+        inter_h = np.asarray(inter)
+        installed = self._materialize_fdbs(t, groups, group_subs, paths, results)
+        n_detours = sum(1 for _, g in installed if inter_h[g] >= 0)
+        return results, n_detours
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------
 

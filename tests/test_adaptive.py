@@ -1,0 +1,322 @@
+"""Tests for UGAL adaptive min/non-min routing (oracle/adaptive.py).
+
+The reference has no adaptive routing to mirror; these tests pin the new
+semantics: weighted APSP against a host Dijkstra oracle, the UGAL
+decision rule (minimal when idle, detour when the minimal route is
+congested), and end-to-end adaptive routing on a dragonfly under the
+adversarial group-shift traffic pattern that motivates UGAL.
+"""
+
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.oracle.adaptive import (
+    congestion_cost,
+    dag_weighted_costs,
+    link_loads,
+    route_adaptive,
+    stitch_paths,
+    ugal_choose,
+    weighted_apsp,
+)
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import dragonfly
+
+
+def host_dijkstra(adj: np.ndarray, cost: np.ndarray) -> np.ndarray:
+    """Reference all-pairs weighted distances (plain heapq Dijkstra)."""
+    v = adj.shape[0]
+    out = np.full((v, v), np.inf)
+    for s in range(v):
+        dist = out[s]
+        dist[s] = 0.0
+        heap = [(0.0, s)]
+        while heap:
+            d, u = heapq.heappop(heap)
+            if d > dist[u]:
+                continue
+            for n in np.nonzero(adj[u] > 0)[0]:
+                nd = d + cost[u, n]
+                if nd < dist[n]:
+                    dist[n] = nd
+                    heapq.heappush(heap, (nd, n))
+    return out
+
+
+@pytest.fixture(scope="module")
+def dfly():
+    """dragonfly(4, 4): 16 routers, diameter 3, 2 global links per pair."""
+    spec = dragonfly(4, 4)
+    db = spec.to_topology_db(backend="jax")
+    t = tensorize(db)
+    return spec, t
+
+
+class TestWeightedAPSP:
+    def test_matches_dijkstra_random_costs(self, dfly):
+        _, t = dfly
+        adj = np.asarray(t.adj)
+        rng = np.random.default_rng(7)
+        cost = rng.uniform(0.5, 4.0, adj.shape).astype(np.float32)
+        dw = np.asarray(
+            weighted_apsp(t.adj, jnp.asarray(cost), max_degree=t.max_degree)
+        )
+        expect = host_dijkstra(adj, cost)
+        real = np.asarray(t.adj).sum(axis=1) > 0  # padding rows are isolated
+        np.testing.assert_allclose(
+            dw[np.ix_(real, real)], expect[np.ix_(real, real)], rtol=1e-5
+        )
+
+    def test_unit_costs_reduce_to_hop_counts(self, dfly):
+        _, t = dfly
+        ones = jnp.where(t.adj > 0, 1.0, jnp.inf)
+        dw = np.asarray(weighted_apsp(t.adj, ones, max_degree=t.max_degree))
+        dist = np.asarray(apsp_distances(t.adj))
+        np.testing.assert_array_equal(dw, dist)
+
+
+class TestDagWeightedCosts:
+    def test_restricted_to_minimal_hop_paths(self):
+        """On a triangle + long cheap arc, the DAG cost must take the
+        direct (1-hop) link even when a 2-hop detour is cheaper — that is
+        exactly the restriction UGAL's comparison needs."""
+        v = 8  # 0-1 direct expensive; 0-2-1 cheap but 2 hops
+        adj = np.zeros((v, v), np.float32)
+        cost = np.full((v, v), np.inf, np.float32)
+        for a, b, c in [(0, 1, 100.0), (0, 2, 1.0), (2, 1, 1.0)]:
+            adj[a, b] = adj[b, a] = 1.0
+            cost[a, b] = cost[b, a] = c
+        adj_j = jnp.asarray(adj)
+        dist = apsp_distances(adj_j)
+        dmin = np.asarray(dag_weighted_costs(adj_j, dist, jnp.asarray(cost), levels=4))
+        dw = np.asarray(weighted_apsp(adj_j, jnp.asarray(cost)))
+        assert dmin[0, 1] == pytest.approx(100.0)  # forced onto the 1-hop path
+        assert dw[0, 1] == pytest.approx(2.0)  # free routing detours
+        assert dmin[0, 2] == pytest.approx(1.0)
+
+    def test_equals_dijkstra_when_all_paths_minimal(self, dfly):
+        """With unit costs every weighted-shortest path is hop-minimal,
+        so the DAG restriction changes nothing."""
+        _, t = dfly
+        ones = jnp.where(t.adj > 0, 1.0, jnp.inf)
+        dist = apsp_distances(t.adj)
+        dmin = np.asarray(
+            dag_weighted_costs(t.adj, dist, ones, levels=4, max_degree=t.max_degree)
+        )
+        np.testing.assert_array_equal(dmin, np.asarray(dist))
+
+
+class TestUgalChoose:
+    def test_idle_fabric_routes_minimal(self, dfly):
+        _, t = dfly
+        cost = jnp.where(t.adj > 0, 1.0, jnp.inf)
+        dw = weighted_apsp(t.adj, cost, max_degree=t.max_degree)
+        src = jnp.asarray(np.arange(8, dtype=np.int32))
+        dst = jnp.asarray((np.arange(8, dtype=np.int32) + 8) % 16)
+        inter = np.asarray(
+            ugal_choose(dw, src, dst, jnp.int32(t.n_real), bias=1.0)
+        )
+        assert (inter == -1).all()  # detours never beat minimal by > bias
+
+    def test_congested_minimal_path_triggers_detour(self, dfly):
+        _, t = dfly
+        adj = np.asarray(t.adj)
+        # saturate every link out of switch 0's group toward group 1
+        util = np.zeros(adj.shape, np.float32)
+        groups = np.arange(adj.shape[0]) // 4
+        hot = (groups[:, None] == 0) & (groups[None, :] == 1) & (adj > 0)
+        hot |= (groups[:, None] == 1) & (groups[None, :] == 0) & (adj > 0)
+        util[hot] = 1000.0
+        cost = congestion_cost(t.adj, jnp.asarray(util))
+        dist = apsp_distances(t.adj)
+        dmin = dag_weighted_costs(
+            t.adj, dist, cost, levels=4, max_degree=t.max_degree
+        )
+        n = 64
+        src = jnp.asarray(np.zeros(n, np.int32))  # group 0
+        dst = jnp.asarray(np.full(n, 5, np.int32))  # group 1
+        inter = np.asarray(
+            ugal_choose(dmin, src, dst, jnp.int32(t.n_real), n_candidates=8)
+        )
+        assert (inter >= 0).mean() > 0.5  # most flows detour
+        # a useful detour avoids both congested groups' direct links
+        assert not np.isin(inter[inter >= 0] // 4, [0, 1]).any()
+        assert (inter < t.n_real).all()
+
+    def test_padding_flows_stay_minimal(self, dfly):
+        _, t = dfly
+        cost = jnp.where(t.adj > 0, 1.0, jnp.inf)
+        dist = apsp_distances(t.adj)
+        dmin = dag_weighted_costs(t.adj, dist, cost, levels=4, max_degree=t.max_degree)
+        src = jnp.asarray(np.array([-1, 0], np.int32))
+        dst = jnp.asarray(np.array([3, -1], np.int32))
+        inter = np.asarray(ugal_choose(dmin, src, dst, jnp.int32(t.n_real)))
+        assert (inter == -1).all()
+
+
+class TestRouteAdaptive:
+    def _shift_flows(self, t, n_per=4):
+        """Adversarial pattern: every router in group x floods group x+1."""
+        src, dst = [], []
+        for s in range(16):
+            g = s // 4
+            for k in range(n_per):
+                src.append(s)
+                dst.append(((g + 1) % 4) * 4 + (s + k) % 4)
+        return (
+            jnp.asarray(np.array(src, np.int32)),
+            jnp.asarray(np.array(dst, np.int32)),
+            jnp.asarray(np.ones(len(src), np.float32)),
+        )
+
+    def test_paths_valid_and_stitched(self, dfly):
+        _, t = dfly
+        src, dst, w = self._shift_flows(t)
+        util = jnp.zeros(t.adj.shape, jnp.float32)
+        inter, n1, n2, _ = route_adaptive(
+            t.adj, util, src, dst, w, jnp.int32(t.n_real),
+            levels=3, max_len=4, bias=1.0,
+        )
+        paths = stitch_paths(n1, n2, inter)
+        adj = np.asarray(t.adj) > 0
+        s_h, d_h = np.asarray(src), np.asarray(dst)
+        for f in range(len(s_h)):
+            p = paths[f][paths[f] >= 0]
+            assert p[0] == s_h[f] and p[-1] == d_h[f], f"flow {f}: {p}"
+            for a, b in zip(p, p[1:]):
+                assert adj[a, b], f"flow {f} uses non-link {a}->{b}"
+
+    def test_adaptive_beats_forced_minimal_under_adversarial_load(self, dfly):
+        """Group 0 floods group 1 while the direct 0<->1 global links are
+        already saturated by background traffic — the canonical pattern
+        where UGAL must detour through a third group."""
+        _, t = dfly
+        v = t.adj.shape[0]
+        adj = np.asarray(t.adj)
+        groups = np.arange(v) // 4
+        n = 32
+        rng = np.random.default_rng(3)
+        src = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))  # group 0
+        dst = jnp.asarray((4 + rng.integers(0, 4, n)).astype(np.int32))  # group 1
+        w = jnp.asarray(np.ones(n, np.float32))
+
+        util = np.zeros((v, v), np.float32)
+        hot = (groups[:, None] == 0) & (groups[None, :] == 1) & (adj > 0)
+        hot |= (groups[:, None] == 1) & (groups[None, :] == 0) & (adj > 0)
+        util[hot] = 1000.0
+        util_j = jnp.asarray(util)
+
+        kw = dict(levels=4, max_len=8, n_candidates=8, max_degree=t.max_degree)
+        inter_a, n1a, n2a, _ = route_adaptive(
+            t.adj, util_j, src, dst, w, jnp.int32(t.n_real), bias=1.0, **kw
+        )
+        inter_m, n1m, n2m, _ = route_adaptive(
+            t.adj, util_j, src, dst, w, jnp.int32(t.n_real), bias=1e9, **kw
+        )
+        assert (np.asarray(inter_m) == -1).all()  # huge bias forces minimal
+        assert (np.asarray(inter_a) >= 0).mean() > 0.5  # most flows detour
+
+        load_a = link_loads(stitch_paths(n1a, n2a, inter_a), w, v)
+        load_m = link_loads(stitch_paths(n1m, n2m, inter_m), w, v)
+        # forced-minimal piles everything onto the saturated direct
+        # links; adaptive moves most of it off them
+        assert load_a[hot].max() < load_m[hot].max()
+
+    def test_idle_fabric_all_minimal_shortest(self, dfly):
+        _, t = dfly
+        src, dst, w = self._shift_flows(t, n_per=1)
+        util = jnp.zeros(t.adj.shape, jnp.float32)
+        inter, n1, n2, _ = route_adaptive(
+            t.adj, util, src, dst, w, jnp.int32(t.n_real),
+            levels=3, max_len=4, bias=1.0,
+        )
+        assert (np.asarray(inter) == -1).all()
+        dist = np.asarray(apsp_distances(t.adj))
+        paths = stitch_paths(n1, n2, inter)
+        for f in range(paths.shape[0]):
+            p = paths[f][paths[f] >= 0]
+            assert len(p) - 1 == dist[p[0], p[-1]]  # minimal => shortest
+
+
+class TestEngineAdaptive:
+    def test_routes_batch_adaptive_idle_is_shortest_and_valid(self):
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+
+        spec = dragonfly(4, 4, hosts_per_router=1)
+        db = spec.to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        macs = sorted(db.hosts)[:8]
+        pairs = [(a, b) for a in macs for b in macs if a != b]
+        fdbs, n_detours = oracle.routes_batch_adaptive(db, pairs)
+        assert n_detours == 0  # idle fabric: UGAL stays minimal
+        plain = oracle.routes_batch(db, pairs)
+        for (a, b), fdb, ref in zip(pairs, fdbs, plain):
+            assert len(fdb) == len(ref), f"{a}->{b} not hop-minimal: {fdb}"
+            # structurally valid: consecutive (dpid, port) hops follow links
+            for (d1, p1), (d2, _) in zip(fdb, fdb[1:]):
+                link = db.links[d1][d2]
+                assert link.src.port_no == p1
+            assert fdb[-1][0] == db.hosts[b].port.dpid
+            assert fdb[-1][1] == db.hosts[b].port.port_no
+
+    def test_routes_batch_adaptive_detours_under_load(self):
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+
+        spec = dragonfly(4, 4, hosts_per_router=1)
+        db = spec.to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        t = oracle.refresh(db)
+        # saturate the direct group-0 <-> group-1 global links (by port)
+        adj = np.asarray(t.adj)
+        groups = np.arange(adj.shape[0]) // 4
+        hot = (groups[:, None] == 0) & (groups[None, :] == 1) & (adj > 0)
+        hot |= (groups[:, None] == 1) & (groups[None, :] == 0) & (adj > 0)
+        port = np.asarray(t.port)
+        link_util = {}
+        for i, j in zip(*np.nonzero(hot)):
+            link_util[(int(t.dpids[i]), int(port[i, j]))] = 1e9
+        g0 = [m for m in sorted(db.hosts) if db.hosts[m].port.dpid <= 4]
+        g1 = [
+            m for m in sorted(db.hosts) if 5 <= db.hosts[m].port.dpid <= 8
+        ]
+        pairs = [(a, b) for a in g0 for b in g1]
+        fdbs, n_detours = oracle.routes_batch_adaptive(
+            db, pairs, link_util=link_util, ugal_candidates=8
+        )
+        assert n_detours > 0
+        for fdb in fdbs:
+            assert fdb  # every pair still routed
+
+    def test_ecmp_subflows_diversify_group_paths(self):
+        """Pairs aggregating to one (edge, edge) transit must not all
+        ride one sampled path — the sub-flow split has to spread them
+        over the fat-tree's equal-cost core paths."""
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+        from sdnmpi_tpu.topogen import fattree
+
+        spec = fattree(8)  # 4 hosts per edge switch, 16 core paths
+        db = spec.to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        edges = sorted({h.port.dpid for h in db.hosts.values()})
+        a_sw, b_sw = edges[0], edges[-1]  # different pods
+        g0 = [m for m in sorted(db.hosts) if db.hosts[m].port.dpid == a_sw]
+        g1 = [m for m in sorted(db.hosts) if db.hosts[m].port.dpid == b_sw]
+        pairs = [(a, b) for a in g0 for b in g1]  # 16 pairs, one transit
+        fdbs, _ = oracle.routes_batch_adaptive(db, pairs, ecmp_ways=4)
+        transits = {tuple(d for d, _ in fdb) for fdb in fdbs}
+        assert len(transits) > 1, f"all 16 pairs on one path: {transits}"
+
+
+class TestStitch:
+    def test_minimal_and_detour_rows(self):
+        n1 = np.array([[0, 1, 2, -1], [0, 3, -1, -1]], np.int32)
+        n2 = np.array([[-1, -1, -1, -1], [3, 4, 5, -1]], np.int32)
+        inter = np.array([-1, 3], np.int32)
+        out = stitch_paths(n1, n2, inter)
+        assert out.shape == (2, 7)
+        assert list(out[0][out[0] >= 0]) == [0, 1, 2]
+        assert list(out[1][out[1] >= 0]) == [0, 3, 4, 5]
