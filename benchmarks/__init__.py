@@ -1,0 +1,16 @@
+"""Benchmark suite: the five BASELINE.md configs.
+
+Each ``configN_*`` module is standalone (``python -m benchmarks.config1_bcast``)
+and prints exactly ONE JSON line ``{"metric", "value", "unit",
+"vs_baseline"}`` on stdout (details on stderr), mirroring the repo-root
+``bench.py`` contract (bench.py IS config 4 — the flagship the driver
+runs). ``python -m benchmarks.run`` executes all five and writes the
+collected lines to ``BENCH_suite.json``.
+
+The reference publishes no numbers (reference: README.md:1-14), so each
+config's ``vs_baseline`` compares against the measurable stand-in
+recorded in BASELINE.md: the pure-Python CPU oracle (configs 1-2), the
+naive single-path route set (config 3), the 50 ms north-star target
+(config 4), and minimal-only routing under adversarial traffic
+(config 5).
+"""

@@ -1,0 +1,59 @@
+"""Shared helpers for the benchmark configs."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    """The one-JSON-line stdout contract shared with bench.py."""
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(float(value), 3),
+                "unit": unit,
+                "vs_baseline": round(float(vs_baseline), 3),
+            }
+        ),
+        flush=True,
+    )
+
+
+def time_fn(fn, warmup: int = 2, iters: int = 10) -> float:
+    """Median wall seconds of ``fn()`` after ``warmup`` calls."""
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def place_ranks(db, n_ranks: int) -> dict[int, str]:
+    """rank -> host MAC, block placement over sorted host MACs."""
+    macs = sorted(db.hosts)
+    if n_ranks > len(macs):
+        raise ValueError(f"{n_ranks} ranks > {len(macs)} hosts")
+    return {r: macs[r] for r in range(n_ranks)}
+
+
+def rank_pairs_to_mac_pairs(pairs: np.ndarray, placement: dict[int, str]):
+    return [(placement[int(s)], placement[int(d)]) for s, d in pairs]
+
+
+def discrete_link_loads(nodes: np.ndarray, weight: np.ndarray, v: int) -> np.ndarray:
+    """[V, V] load matrix from node-sequence paths (-1 padded)."""
+    from sdnmpi_tpu.oracle.adaptive import link_loads
+
+    return link_loads(nodes, weight, v)

@@ -1,0 +1,40 @@
+"""Config 1: 8-rank MPI_Bcast on the 4-switch linear topology.
+
+BASELINE.md target: parity with the CPU oracle + golden-test
+correctness. The JAX oracle must produce byte-identical fdbs to the
+pure-Python BFS backend (the reference's semantics, reference:
+sdnmpi/util/topology_db.py:140-188) for every pair of the binomial
+broadcast tree; the reported number is the batch route latency, with
+``vs_baseline`` = CPU-loop time / JAX-batch time.
+"""
+
+from __future__ import annotations
+
+from benchmarks.common import emit, log, place_ranks, rank_pairs_to_mac_pairs, time_fn
+from sdnmpi_tpu.collectives import bcast_binomial_pairs
+from sdnmpi_tpu.topogen import linear
+
+N_RANKS = 8
+
+
+def main() -> None:
+    spec = linear(4, hosts_per_switch=2)  # 8 hosts on 4 switches
+    db_jax = spec.to_topology_db(backend="jax")
+    db_py = spec.to_topology_db(backend="py")
+    placement = place_ranks(db_jax, N_RANKS)
+    pairs = rank_pairs_to_mac_pairs(bcast_binomial_pairs(N_RANKS), placement)
+    log(f"bcast({N_RANKS}) on linear:4 -> {len(pairs)} rank pairs")
+
+    got = db_jax.find_routes_batch(pairs)
+    want = [db_py.find_route(s, d) for s, d in pairs]
+    assert got == want, f"parity failure:\n jax={got}\n py ={want}"
+    log("golden parity: JAX batch fdbs == pure-Python BFS fdbs")
+
+    t_jax = time_fn(lambda: db_jax.find_routes_batch(pairs))
+    t_py = time_fn(lambda: [db_py.find_route(s, d) for s, d in pairs])
+    log(f"jax batch {t_jax * 1e3:.3f} ms vs py loop {t_py * 1e3:.3f} ms")
+    emit("bcast8_linear4_route_ms", t_jax * 1e3, "ms", t_py / t_jax)
+
+
+if __name__ == "__main__":
+    main()

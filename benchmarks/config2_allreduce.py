@@ -1,0 +1,91 @@
+"""Config 2: 64-rank MPI_Allreduce on a 2-level fat-tree (k=8).
+
+BASELINE.md target: JAX APSP >= the CPU graph-library baseline. The
+CPU baseline is an adjacency-list BFS all-pairs sweep (what the
+reference's Python oracle would cost if asked for all pairs,
+reference: sdnmpi/util/topology_db.py:59-84); the JAX number is the
+full APSP (distances + next hops) on device. Correctness: distance
+matrices must match exactly, and the ring-allreduce batch must route
+every pair. vs_baseline = CPU APSP time / JAX APSP time.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from benchmarks.common import emit, log, place_ranks, rank_pairs_to_mac_pairs, time_fn
+from sdnmpi_tpu.collectives import allreduce_ring_pairs
+from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import fattree
+
+N_RANKS = 64
+K = 8
+
+
+def cpu_apsp(adj_list: list[list[int]]) -> np.ndarray:
+    v = len(adj_list)
+    dist = np.full((v, v), np.inf, np.float32)
+    for s in range(v):
+        dist[s, s] = 0.0
+        q = deque([s])
+        while q:
+            u = q.popleft()
+            for w in adj_list[u]:
+                if not np.isfinite(dist[s, w]):
+                    dist[s, w] = dist[s, u] + 1
+                    q.append(w)
+    return dist
+
+
+def main() -> None:
+    spec = fattree(K)  # k=8: 16 agg + 16 edge + 16 core-ish (2-level pods)
+    db = spec.to_topology_db(backend="jax")
+    t = tensorize(db)
+    adj = np.asarray(t.adj)
+    v = adj.shape[0]
+    log(f"fattree k={K}: {spec.n_switches} switches (padded {v}), "
+        f"{spec.n_hosts} hosts")
+
+    adj_list = [list(np.nonzero(adj[i] > 0)[0]) for i in range(v)]
+    t_cpu = time_fn(lambda: cpu_apsp(adj_list), warmup=1, iters=3)
+
+    import jax
+
+    # one fused device program (single dispatch): distances + next hops.
+    # Timed as a pipelined stream (issue all, block once): dispatches
+    # overlap, so the number is steady-state throughput per APSP — the
+    # way the controller consumes oracle refreshes — not the remote
+    # tunnel's single-dispatch latency floor.
+    fused = jax.jit(lambda a: apsp_next_hops(a, apsp_distances(a)))
+    adj_dev = jax.device_put(t.adj)
+    fused(adj_dev).block_until_ready()  # compile
+
+    import time as _time
+
+    n_stream = 20
+    t0 = _time.perf_counter()
+    outs = [fused(adj_dev) for _ in range(n_stream)]
+    outs[-1].block_until_ready()
+    t_jax = (_time.perf_counter() - t0) / n_stream
+    np.testing.assert_array_equal(
+        np.asarray(apsp_distances(t.adj)), cpu_apsp(adj_list)
+    )
+    log(f"APSP: jax {t_jax * 1e3:.3f} ms (dist+next hops) vs cpu BFS "
+        f"{t_cpu * 1e3:.1f} ms (dist only)")
+
+    placement = place_ranks(db, N_RANKS)
+    pairs = rank_pairs_to_mac_pairs(
+        np.unique(allreduce_ring_pairs(N_RANKS), axis=0), placement
+    )
+    fdbs = db.find_routes_batch(pairs)
+    assert all(fdbs), "ring allreduce pair failed to route"
+    log(f"ring allreduce: {len(pairs)} unique pairs all routed")
+
+    emit("allreduce64_fattree8_apsp_ms", t_jax * 1e3, "ms", t_cpu / t_jax)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Run all five BASELINE.md benchmark configs; collect JSON lines.
+
+Each config runs in a subprocess (fresh XLA client, honest compile
+boundaries). Config 4 is the repo-root ``bench.py`` flagship. Results
+land in ``BENCH_suite.json`` and on stdout (one line per config).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+CONFIGS = [
+    ("1", [sys.executable, "-m", "benchmarks.config1_bcast"]),
+    ("2", [sys.executable, "-m", "benchmarks.config2_allreduce"]),
+    ("3", [sys.executable, "-m", "benchmarks.config3_alltoall512"]),
+    ("4", [sys.executable, "bench.py"]),
+    ("5", [sys.executable, "-m", "benchmarks.config5_dragonfly"]),
+]
+
+
+def main() -> None:
+    root = pathlib.Path(__file__).resolve().parent.parent
+    results = []
+    for name, cmd in CONFIGS:
+        print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr, flush=True)
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, timeout=1800
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            results.append({"config": name, "error": proc.returncode})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        line = proc.stdout.strip().splitlines()[-1]
+        rec = {"config": name, **json.loads(line)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    (root / "BENCH_suite.json").write_text(json.dumps(results, indent=2) + "\n")
+    failed = [r for r in results if "error" in r]
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

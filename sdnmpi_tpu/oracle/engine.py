@@ -107,6 +107,7 @@ class RouteOracle:
         self._tensors: Optional[TopoTensors] = None
         self._dist: Optional[np.ndarray] = None
         self._next: Optional[np.ndarray] = None
+        self._port: Optional[np.ndarray] = None
 
     # -- cache management -------------------------------------------------
 
@@ -118,6 +119,7 @@ class RouteOracle:
             self._tensors = tensors
             self._dist = np.asarray(dist)
             self._next = np.asarray(nxt)
+            self._port = np.asarray(tensors.port)  # host copy for chasing
             self._version = db.version
         return self._tensors
 
@@ -295,13 +297,21 @@ class RouteOracle:
         needed = int(sel[finite].max()) + 1
         return ((needed + 7) // 8) * 8
 
+    #: below this many total hops (pairs x path length), next-hop chasing
+    #: on the host against the cached matrices beats a device dispatch —
+    #: the device round-trip (sub-ms on-chip, ~100 ms through a remote
+    #: TPU tunnel) swamps tiny batches. Large collectives amortize it.
+    host_chase_hop_budget: int = 4096
+
     def routes_batch(
         self, db: "TopologyDB", pairs: list[tuple[str, str]]
     ) -> list[list[tuple[int, int]]]:
         """Resolve a batch of (src_mac, dst_mac) pairs to fdbs.
 
         Endpoint resolution happens on host; the hop/port extraction for
-        the whole batch is a single device call (oracle/paths.batch_fdb).
+        the whole batch is a single device call (oracle/paths.batch_fdb),
+        except for small batches, which chase the cached next-hop matrix
+        on the host with zero device round-trips.
         """
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
@@ -315,6 +325,22 @@ class RouteOracle:
 
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
+            return results
+
+        if len(rows) * max_len <= self.host_chase_hop_budget:
+            port_mat = self._port  # cached host copy: no device round-trip
+            dpids = t.dpids
+            for (k, si, di, fport) in rows:
+                if not np.isfinite(self._dist[si, di]):
+                    continue
+                fdb: list[tuple[int, int]] = []
+                node = si
+                while node != di:
+                    nxt = int(self._next[node, di])
+                    fdb.append((int(dpids[node]), int(port_mat[node, nxt])))
+                    node = nxt
+                fdb.append((int(dpids[di]), int(fport)))
+                results[k] = fdb
             return results
 
         nodes, ports, length = batch_fdb(
