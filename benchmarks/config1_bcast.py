@@ -25,14 +25,21 @@ def main() -> None:
     pairs = rank_pairs_to_mac_pairs(bcast_binomial_pairs(N_RANKS), placement)
     log(f"bcast({N_RANKS}) on linear:4 -> {len(pairs)} rank pairs")
 
-    got = db_jax.find_routes_batch(pairs)
     want = [db_py.find_route(s, d) for s, d in pairs]
-    assert got == want, f"parity failure:\n jax={got}\n py ={want}"
-    log("golden parity: JAX batch fdbs == pure-Python BFS fdbs")
+    # golden parity for BOTH oracle paths: the small-batch host chase
+    # (the default for a 7-pair batch) and the device batch_fdb path
+    got_host = db_jax.find_routes_batch(pairs)
+    assert got_host == want, f"host-chase parity failure:\n {got_host}\n {want}"
+    db_jax._oracle.host_chase_hop_budget = 0  # force the device path
+    got_dev = db_jax.find_routes_batch(pairs)
+    assert got_dev == want, f"device parity failure:\n {got_dev}\n {want}"
+    db_jax._oracle.host_chase_hop_budget = 4096
+    log("golden parity: host-chase AND device batch fdbs == pure-Python BFS")
 
     t_jax = time_fn(lambda: db_jax.find_routes_batch(pairs))
     t_py = time_fn(lambda: [db_py.find_route(s, d) for s, d in pairs])
-    log(f"jax batch {t_jax * 1e3:.3f} ms vs py loop {t_py * 1e3:.3f} ms")
+    log(f"tensorized oracle (host fast path over cached device matrices) "
+        f"{t_jax * 1e3:.3f} ms vs py BFS loop {t_py * 1e3:.3f} ms")
     emit("bcast8_linear4_route_ms", t_jax * 1e3, "ms", t_py / t_jax)
 
 

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from benchmarks.common import discrete_link_loads, emit, log, time_fn
+from benchmarks.common import emit, log, stream_throughput
+from sdnmpi_tpu.oracle.adaptive import link_loads
 from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
 from sdnmpi_tpu.oracle.congestion import aggregate_pairs
 from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
@@ -66,36 +67,25 @@ def main() -> None:
 
     buf = run()  # compile + warm
     run()
-    # pipelined stream with async readback (same harness as bench.py):
-    # copy_to_host_async + a reader pool overlap the tunnel's fetch
-    # latency with device compute, measuring steady-state throughput —
-    # how the controller actually consumes collectives
-    import time as _time
-    from concurrent.futures import ThreadPoolExecutor
 
-    def dispatch():
+    def dispatch_fetch(i):
         b = route_collective(*args, **kw)
         try:
             b.copy_to_host_async()
         except Exception:
             pass
-        return b
+        return np.asarray(b)
 
-    n_stream = 10
-    pool = ThreadPoolExecutor(4)
-    t0 = _time.perf_counter()
-    futs = [pool.submit(np.asarray, dispatch()) for _ in range(n_stream)]
-    for f in futs:
-        f.result()
-    t_route = (_time.perf_counter() - t0) / n_stream
+    t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route = t_route_ms / 1e3
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     nodes = slots_to_nodes(adj, usrc, slots, udst)
     assert (nodes[:, 0] == usrc).all()
-    load = discrete_link_loads(nodes, weight, v)
+    load = link_loads(nodes, weight, v)
 
     nxt = apsp_next_hops(t.adj, apsp_distances(t.adj))
     naive, _ = batch_paths(nxt, jax.device_put(usrc), jax.device_put(udst), max_len)
-    naive_load = discrete_link_loads(np.asarray(naive), weight, v)
+    naive_load = link_loads(np.asarray(naive), weight, v)
     log(f"route {t_route * 1e3:.2f} ms; max congestion balanced "
         f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
     emit(

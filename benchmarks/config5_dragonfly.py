@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from benchmarks.common import emit, log, time_fn
+from benchmarks.common import emit, log, stream_throughput
 from sdnmpi_tpu.oracle.adaptive import link_loads, route_adaptive, stitch_paths
 from sdnmpi_tpu.oracle.engine import tensorize
 from sdnmpi_tpu.topogen import dragonfly
@@ -68,12 +68,7 @@ def main() -> None:
     inter_a, n1a, n2a = run(1.0)
     run(1.0)  # warm
 
-    # pipelined stream with async readback (same harness as bench.py):
-    # steady-state per-batch throughput, fetches overlapped with compute
-    import time as _time
-    from concurrent.futures import ThreadPoolExecutor
-
-    def dispatch():
+    def dispatch_fetch(i):
         outs = route_adaptive(
             t.adj, util_j, src_j, dst_j, w_j, n_real_j, bias=1.0, **kw,
         )[:3]
@@ -82,18 +77,10 @@ def main() -> None:
                 o.copy_to_host_async()
             except Exception:
                 pass
-        return outs
+        return [np.asarray(o) for o in outs]
 
-    n_stream = 10
-    pool = ThreadPoolExecutor(4)
-    t0 = _time.perf_counter()
-    futs = [
-        pool.submit(lambda os: [np.asarray(o) for o in os], dispatch())
-        for _ in range(n_stream)
-    ]
-    for f in futs:
-        f.result()
-    t_route = (_time.perf_counter() - t0) / n_stream
+    t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route = t_route_ms / 1e3
     inter_m, n1m, n2m = run(1e9)  # hysteresis so high UGAL never detours
 
     inter_a, inter_m = np.asarray(inter_a), np.asarray(inter_m)

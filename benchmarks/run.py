@@ -26,16 +26,23 @@ def main() -> None:
     results = []
     for name, cmd in CONFIGS:
         print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr, flush=True)
-        proc = subprocess.run(
-            cmd, cwd=root, capture_output=True, text=True, timeout=1800
-        )
-        sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            results.append({"config": name, "error": proc.returncode})
+        try:
+            proc = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=1800
+            )
+        except subprocess.TimeoutExpired:
+            results.append({"config": name, "error": "timeout"})
             print(json.dumps(results[-1]), flush=True)
             continue
-        line = proc.stdout.strip().splitlines()[-1]
-        rec = {"config": name, **json.loads(line)}
+        sys.stderr.write(proc.stderr)
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode != 0 or not lines:
+            results.append(
+                {"config": name, "error": proc.returncode or "no output"}
+            )
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        rec = {"config": name, **json.loads(lines[-1])}
         results.append(rec)
         print(json.dumps(rec), flush=True)
     (root / "BENCH_suite.json").write_text(json.dumps(results, indent=2) + "\n")

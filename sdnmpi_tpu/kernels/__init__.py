@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the oracle's hot ops."""
+
+from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
+
+__all__ = ["bfs_distances_pallas", "pallas_supported"]

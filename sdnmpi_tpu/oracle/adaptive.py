@@ -239,12 +239,21 @@ def route_adaptive(
     load [V, V])`` — segment paths are stitched host-side by
     :func:`stitch_paths`; ``load`` is the fractional link-load matrix of
     the balanced assignment (its max is the congestion metric).
+
+    PRECONDITION: when ``dist`` is not supplied on TPU, ``levels`` must
+    upper-bound the graph diameter — the fused Pallas BFS runs exactly
+    ``levels`` steps and reports longer paths unreachable (see
+    route_collective's note; passing the cached ``dist`` avoids this).
     """
+    from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
     from sdnmpi_tpu.oracle.apsp import apsp_distances
 
     v = adj.shape[0]
     if dist is None:
-        dist = apsp_distances(adj)
+        if pallas_supported(v):
+            dist = bfs_distances_pallas(adj, levels=levels)
+        else:
+            dist = apsp_distances(adj)
     cost = congestion_cost(adj, util)
     dmin = dag_weighted_costs(adj, dist, cost, levels=levels, max_degree=max_degree)
     inter = ugal_choose(

@@ -363,7 +363,17 @@ def route_collective(
     collective over the DAG, samples every flow's discrete path, and
     packs ``slots`` (int8 [F * max_len]) + the bitcast f32 max-link
     congestion into ONE int8 buffer so the host pays a single fetch.
+
+    PRECONDITION: ``levels`` must upper-bound the graph diameter. On
+    TPU the fused Pallas BFS runs exactly ``levels`` steps, so pairs
+    farther than ``levels`` hops read as unreachable (the XLA fallback
+    converges fully and merely wouldn't *route* them, since the DAG
+    propagation and sampling are equally bounded by levels/max_len —
+    but only the TPU path changes their *distances*). Callers derive
+    levels from the measured diameter (bench.py) or the batch's max
+    distance (engine.routes_batch_adaptive, which passes dist=cached).
     """
+    from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
     from sdnmpi_tpu.oracle.apsp import apsp_distances
 
     v = adj.shape[0]
@@ -372,7 +382,12 @@ def route_collective(
         .at[link_src, link_dst]
         .set(link_util, unique_indices=True, mode="drop")
     )
-    dist = apsp_distances(adj)
+    # fused VMEM-resident BFS on TPU (levels is the static diameter
+    # bound); XLA while_loop formulation elsewhere
+    if pallas_supported(v):
+        dist = bfs_distances_pallas(adj, levels=levels)
+    else:
+        dist = apsp_distances(adj)
     weights, _, maxc = balance_rounds(
         adj, dist, base, traffic, levels=levels, rounds=rounds
     )
