@@ -16,7 +16,7 @@ from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
 from sdnmpi_tpu.core.rank_allocation_db import RankAllocationDB
 from sdnmpi_tpu.protocol import openflow as of
-from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.announcement import AnnouncementType
 from sdnmpi_tpu.utils.mac import BROADCAST_MAC
 
 log = logging.getLogger("ProcessManager")
@@ -61,20 +61,26 @@ class ProcessManager:
             return
         if pkt.udp_dst != self.config.announcement_port:
             return
-        try:
-            ann = Announcement.decode(pkt.payload)
-        except ValueError as exc:
-            log.warning("malformed announcement from %s: %s", pkt.eth_src, exc)
-            return
+        # batch-parse the datagram with the native wire codec: a payload
+        # may coalesce many records (an MPI runtime launching thousands
+        # of ranks batches its announcements; the reference parses only
+        # a single fixed-size record, sdnmpi/process.py:101-105).
+        # Malformed records are dropped by the decoder.
+        from sdnmpi_tpu.native import decode_announcements
 
-        if ann.type == AnnouncementType.LAUNCH:
-            self.rankdb.add_process(ann.rank, pkt.eth_src)
-            self.bus.publish(ev.EventProcessAdd(ann.rank, pkt.eth_src))
-            log.info("MPI process %s started at %s", ann.rank, pkt.eth_src)
-        elif ann.type == AnnouncementType.EXIT:
-            self.rankdb.delete_process(ann.rank)
-            self.bus.publish(ev.EventProcessDelete(ann.rank))
-            log.info("MPI process %s exited at %s", ann.rank, pkt.eth_src)
+        types, ranks = decode_announcements(pkt.payload)
+        if len(types) == 0:
+            log.warning("malformed announcement from %s", pkt.eth_src)
+            return
+        for type_code, rank in zip(types, ranks):
+            if type_code == AnnouncementType.LAUNCH:
+                self.rankdb.add_process(int(rank), pkt.eth_src)
+                self.bus.publish(ev.EventProcessAdd(int(rank), pkt.eth_src))
+                log.info("MPI process %s started at %s", rank, pkt.eth_src)
+            elif type_code == AnnouncementType.EXIT:
+                self.rankdb.delete_process(int(rank))
+                self.bus.publish(ev.EventProcessDelete(int(rank)))
+                log.info("MPI process %s exited at %s", rank, pkt.eth_src)
 
     def _rank_resolution(self, req: ev.RankResolutionRequest) -> ev.RankResolutionReply:
         return ev.RankResolutionReply(self.rankdb.get_mac(req.rank))

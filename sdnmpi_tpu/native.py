@@ -1,0 +1,208 @@
+"""ctypes bindings for the native host-runtime kernels (native/).
+
+The device computes routes; the host decodes and installs them. These
+bindings accelerate the host side of that pipeline — slot-stream
+decoding, link-load accounting, fdb materialization, announcement
+parsing — with the C++ library built from ``native/sdnmpi_native.cpp``.
+Every entry point has a pure-numpy fallback, so the framework works
+without the shared library; ``available()`` reports which path is live.
+
+The library is looked up in ``native/build/`` and built on demand with
+``make`` when a toolchain is present (g++ is part of the dev image; the
+reference itself has no native code to mirror — this layer is the
+runtime-native part of the rebuild).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libsdnmpi_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("SDNMPI_NO_NATIVE"):
+        return None
+    if (_NATIVE_DIR / "Makefile").exists():
+        try:  # always invoke make: a fresh .so is a no-op, a stale one
+            # (edited .cpp) rebuilds; stay silent on any failure
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                capture_output=True, timeout=120, check=True,
+            )
+        except Exception:
+            pass  # fall through: a previously-built .so may still load
+    if not _LIB_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        i8p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64 = ctypes.c_int64
+        lib.decode_slots.argtypes = [i8p, i32p, i32p, i32p, i64, i64, i64, i64, i32p]
+        lib.decode_slots.restype = None
+        lib.link_loads.argtypes = [i32p, f32p, i64, i64, i64, f32p]
+        lib.link_loads.restype = None
+        lib.materialize_fdbs.argtypes = [
+            i32p, i32p, i64p, i32p, i32p, i64, i64, i64, i64p, i32p, i32p,
+        ]
+        lib.materialize_fdbs.restype = None
+        lib.decode_announcements.argtypes = [u8p, i64, i32p, i32p]
+        lib.decode_announcements.restype = i64
+        lib.encode_announcements.argtypes = [i32p, i32p, i64, u8p]
+        lib.encode_announcements.restype = None
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    """Whether the C++ kernels are loaded (False -> numpy fallbacks)."""
+    return _load() is not None
+
+
+def neighbor_order(adj: np.ndarray) -> np.ndarray:
+    """[V, V] sorted-out-neighbor table (entries == V mark invalid),
+    shared by the decoders — same construction as dag.slots_to_nodes."""
+    a = np.asarray(adj) > 0
+    v = a.shape[0]
+    order = np.where(a, np.arange(v, dtype=np.int32)[None, :], v).astype(np.int32)
+    order.sort(axis=1)
+    return order
+
+
+def decode_slots(
+    slots: np.ndarray, order: np.ndarray, src: np.ndarray, dst: np.ndarray
+) -> np.ndarray:
+    """slots [F, L] int8 + sorted-neighbor table -> nodes [F, L] int32."""
+    lib = _load()
+    slots = np.ascontiguousarray(slots, np.int8)
+    order = np.ascontiguousarray(order, np.int32)
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    f, l = slots.shape
+    v, d = order.shape
+    if l == 0:
+        return np.empty((f, 0), np.int32)
+    if lib is None:  # numpy fallback, identical semantics
+        s32 = slots.astype(np.int32)
+        valid = (s32[:, 0] >= 0) | (src == dst)
+        nodes = np.full((f, l), -1, np.int32)
+        node = np.where(valid & (src >= 0), src, -1)
+        for h in range(l):
+            nodes[:, h] = node
+            s = s32[:, h]
+            ok = (s >= 0) & (node >= 0) & (s < d)
+            nxt = order[np.maximum(node, 0), np.maximum(np.minimum(s, d - 1), 0)]
+            node = np.where(ok & (nxt < v), nxt, -1)
+        return nodes
+    nodes = np.empty((f, l), np.int32)
+    lib.decode_slots(slots, order, src, dst, f, l, v, d, nodes)
+    return nodes
+
+
+def link_loads(nodes: np.ndarray, weight: np.ndarray, v: int) -> np.ndarray:
+    """Discrete [V, V] link loads of node paths (native scatter-add)."""
+    lib = _load()
+    nodes = np.ascontiguousarray(nodes, np.int32)
+    weight = np.ascontiguousarray(weight, np.float32)
+    load = np.zeros((v, v), np.float32)
+    if lib is None:  # numpy fallback (np.add.at)
+        for h in range(nodes.shape[1] - 1):
+            a, b = nodes[:, h], nodes[:, h + 1]
+            sel = (a >= 0) & (b >= 0)
+            np.add.at(load, (a[sel], b[sel]), weight[sel])
+        return load
+    f, l = nodes.shape
+    lib.link_loads(nodes, weight, f, l, v, load)
+    return load
+
+
+def materialize_fdbs(
+    paths: np.ndarray,
+    port: np.ndarray,
+    dpids: np.ndarray,
+    dst_switch: np.ndarray,
+    final_port: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Batch fdb hop lists: returns (dpid [F, L] i64, port [F, L] i32,
+    length [F] i32); length 0 = not installable (truncated/unreachable).
+    ``dst_switch[i] = -1`` accepts any path endpoint."""
+    lib = _load()
+    paths = np.ascontiguousarray(paths, np.int32)
+    port = np.ascontiguousarray(port, np.int32)
+    dpids = np.ascontiguousarray(dpids, np.int64)
+    dst_switch = np.ascontiguousarray(dst_switch, np.int32)
+    final_port = np.ascontiguousarray(final_port, np.int32)
+    f, l = paths.shape
+    v = port.shape[0]
+    out_dpid = np.full((f, l), -1, np.int64)
+    out_port = np.full((f, l), -1, np.int32)
+    out_len = np.zeros(f, np.int32)
+    if lib is None:
+        for i in range(f):
+            row = paths[i][paths[i] >= 0]
+            if len(row) == 0:
+                continue
+            if dst_switch[i] >= 0 and row[-1] != dst_switch[i]:
+                continue
+            for h in range(len(row) - 1):
+                out_dpid[i, h] = dpids[row[h]]
+                out_port[i, h] = port[row[h], row[h + 1]]
+            out_dpid[i, len(row) - 1] = dpids[row[-1]]
+            out_port[i, len(row) - 1] = final_port[i]
+            out_len[i] = len(row)
+        return out_dpid, out_port, out_len
+    lib.materialize_fdbs(
+        paths, port, dpids, dst_switch, final_port, f, l, v,
+        out_dpid, out_port, out_len,
+    )
+    return out_dpid, out_port, out_len
+
+
+def decode_announcements(buf: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Batch-parse concatenated announcement records -> (types, ranks)."""
+    lib = _load()
+    data = np.frombuffer(bytes(buf), np.uint8)
+    n_max = len(data) // 8
+    if lib is None:
+        recs = np.frombuffer(bytes(buf[: n_max * 8]), "<i4").reshape(-1, 2)
+        ok = (recs[:, 0] == 0) | (recs[:, 0] == 1)
+        return recs[ok, 0].astype(np.int32), recs[ok, 1].astype(np.int32)
+    types = np.empty(n_max, np.int32)
+    ranks = np.empty(n_max, np.int32)
+    n = lib.decode_announcements(data, len(data), types, ranks)
+    return types[:n], ranks[:n]
+
+
+def encode_announcements(types: np.ndarray, ranks: np.ndarray) -> bytes:
+    """Inverse of decode_announcements (batch wire encoding)."""
+    lib = _load()
+    types = np.ascontiguousarray(types, np.int32)
+    ranks = np.ascontiguousarray(ranks, np.int32)
+    if lib is None:
+        out = np.empty((len(types), 2), "<i4")
+        out[:, 0] = types
+        out[:, 1] = ranks
+        return out.tobytes()
+    buf = np.empty(len(types) * 8, np.uint8)
+    lib.encode_announcements(types, ranks, len(types), buf)
+    return buf.tobytes()

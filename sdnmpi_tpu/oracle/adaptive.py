@@ -310,11 +310,12 @@ def stitch_paths(nodes1, nodes2, inter) -> np.ndarray:
 
 
 def link_loads(paths: np.ndarray, weight: np.ndarray, v: int) -> np.ndarray:
-    """Discrete [V, V] link loads of stitched paths (host-side, validation)."""
-    paths = np.asarray(paths, np.int32)
-    load = np.zeros((v, v), np.float32)
-    for h in range(paths.shape[1] - 1):
-        a, b = paths[:, h], paths[:, h + 1]
-        sel = (a >= 0) & (b >= 0)
-        np.add.at(load, (a[sel], b[sel]), np.asarray(weight, np.float32)[sel])
-    return load
+    """Discrete [V, V] link loads of stitched paths (host-side).
+
+    Delegates to the native C++ scatter-add when available (~5x over
+    np.add.at at collective scale), numpy otherwise — see
+    sdnmpi_tpu/native.py.
+    """
+    from sdnmpi_tpu import native
+
+    return native.link_loads(paths, weight, v)

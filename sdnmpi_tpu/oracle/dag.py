@@ -315,8 +315,23 @@ def slots_to_nodes(adj, src, slots, dst=None):
     [F, L] int32 nodes padded with -1 (numpy, no device involved).
     ``dst`` distinguishes a src==dst flow (path = [src]) from an
     unreachable one (all -1) — both have an all--1 slot stream.
+
+    Dispatches to the C++ decoder (sdnmpi_tpu/native.py) when the
+    shared library is available; this numpy body is the fallback and
+    the parity reference.
     """
     import numpy as np
+
+    src = np.asarray(src, np.int32)
+    if dst is not None:
+        from sdnmpi_tpu import native
+
+        if native.available():
+            order = native.neighbor_order(adj)
+            return native.decode_slots(
+                np.asarray(slots, np.int8), order, src,
+                np.asarray(dst, np.int32),
+            )
 
     a = np.asarray(adj) > 0
     v = a.shape[0]
@@ -324,7 +339,6 @@ def slots_to_nodes(adj, src, slots, dst=None):
     order.sort(axis=1)
     slots = np.asarray(slots, np.int32)
     f, l = slots.shape
-    src = np.asarray(src, np.int32)
     valid = (slots[:, 0] >= 0) | (src >= 0)
     if dst is not None:
         valid = (slots[:, 0] >= 0) | (src == np.asarray(dst, np.int32))

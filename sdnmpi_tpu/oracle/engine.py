@@ -268,21 +268,36 @@ class RouteOracle:
         dealt onto its group's sub-flows round-robin. A path that does
         not end at the pair's destination switch (truncated/unreachable)
         is not installable and leaves the pair unrouted. Returns the
-        ``(pair index, sub-flow index)`` of every installed pair."""
-        port_mat = np.asarray(t.port)
-        dpids = t.dpids
+        ``(pair index, sub-flow index)`` of every installed pair.
+
+        The per-hop decode (port lookups, endpoint validation) runs in
+        the native batch kernel (sdnmpi_tpu/native.py) — one pass over
+        all sub-flows; members of a group share the decoded transit hops
+        and differ only in the appended final (host) port."""
+        from sdnmpi_tpu import native
+
+        n_sub = paths.shape[0]
+        dst_sw = np.full(n_sub, -1, np.int32)
+        for key, (first, nsub) in group_subs.items():
+            dst_sw[first : first + nsub] = key[1]
+        od, op, ln = native.materialize_fdbs(
+            paths, self._port, t.dpids, dst_sw, np.zeros(n_sub, np.int32)
+        )
+
+        hop_lists: list[Optional[list[tuple[int, int]]]] = [None] * n_sub
         installed: list[tuple[int, int]] = []
         for key, members in groups.items():
             first, nsub = group_subs[key]
             for j, (k, final_port) in enumerate(members):
                 g = first + j % nsub
-                path = paths[g][paths[g] >= 0]
-                if len(path) == 0 or path[-1] != key[1]:
+                n = int(ln[g])
+                if n == 0:
                     continue
-                results[k] = [
-                    (int(dpids[path[h]]), int(port_mat[path[h], path[h + 1]]))
-                    for h in range(len(path) - 1)
-                ] + [(int(dpids[path[-1]]), final_port)]
+                hops = hop_lists[g]
+                if hops is None:
+                    hops = [(int(od[g, h]), int(op[g, h])) for h in range(n - 1)]
+                    hop_lists[g] = hops
+                results[k] = hops + [(int(od[g, n - 1]), final_port)]
                 installed.append((k, g))
         return installed
 

@@ -97,6 +97,27 @@ class TestProcessLifecycle:
         assert rankdb.get_mac(0) is None
         assert [e.rank for e in deleted] == [0]
 
+    def test_coalesced_announcement_batch(self, stack):
+        """One datagram carrying many records registers every rank (the
+        native batch codec path; the reference parses only the first
+        fixed-size record)."""
+        fabric, controller = stack
+        payload = b"".join(
+            Announcement(AnnouncementType.LAUNCH, r).encode() for r in range(5)
+        )
+        pkt = of.Packet(
+            eth_src=MAC[2],
+            eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP,
+            ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=payload,
+        )
+        fabric.hosts[MAC[2]].send(pkt)
+        rankdb = controller.process_manager.rankdb
+        for r in range(5):
+            assert rankdb.get_mac(r) == MAC[2]
+
     def test_announcement_not_flooded_to_hosts(self, stack):
         fabric, controller = stack
         announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
