@@ -79,7 +79,7 @@ def main() -> None:
     t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
     t_route = t_route_ms / 1e3
     slots, maxc = unpack_result(buf, len(usrc), max_len)
-    nodes = slots_to_nodes(adj, usrc, slots, udst)
+    nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
     assert (nodes[:, 0] == usrc).all()
     load = link_loads(nodes, weight, v)
 

@@ -26,17 +26,23 @@ extern "C" {
 // order:  [V, D] int32 — sorted out-neighbor table (entries >= V invalid)
 // src:    [F] int32    — start nodes (-1 = dead flow)
 // dst:    [F] int32    — destinations (distinguishes src==dst from dead)
-// nodes:  [F, L] int32 out, -1 padded
+// complete: nonzero -> the slot stream omits the forced final hop (see
+//           oracle/dag.sampled_hops); the decoder emits the walked node
+//           at column L and appends dst at column L+1 when the walked
+//           node is a verified neighbor of dst. Output is then [F, L+2]
+//           (entire row -1 if the walk ends non-adjacent to dst —
+//           truncated, not installable). Zero -> output [F, L] raw walk.
 //
 // Mirrors sdnmpi_tpu.oracle.dag.slots_to_nodes exactly.
 void decode_slots(const int8_t* slots, const int32_t* order,
                   const int32_t* src, const int32_t* dst,
                   int64_t f, int64_t l, int64_t v, int64_t d,
-                  int32_t* nodes) {
+                  int32_t complete, int32_t* nodes) {
   if (l == 0) return;
+  const int64_t out_l = complete ? l + 2 : l;
   for (int64_t i = 0; i < f; ++i) {
     const int8_t* srow = slots + i * l;
-    int32_t* nrow = nodes + i * l;
+    int32_t* nrow = nodes + i * out_l;
     bool valid = (srow[0] >= 0) || (src[i] >= 0 && src[i] == dst[i]);
     int32_t node = valid ? src[i] : -1;
     for (int64_t h = 0; h < l; ++h) {
@@ -47,6 +53,22 @@ void decode_slots(const int8_t* slots, const int32_t* order,
         node = (nxt < v) ? nxt : -1;
       } else {
         node = -1;
+      }
+    }
+    if (complete) {
+      nrow[l] = node;
+      nrow[l + 1] = -1;
+      if (node >= 0 && node != dst[i]) {
+        bool adjacent = false;  // linear scan of the sorted slot row
+        const int32_t* orow = order + (int64_t)node * d;
+        for (int64_t k = 0; k < d && orow[k] < v; ++k) {
+          if (orow[k] == dst[i]) { adjacent = true; break; }
+        }
+        if (adjacent) {
+          nrow[l + 1] = dst[i];
+        } else {  // truncated walk: whole row not installable
+          for (int64_t h = 0; h < out_l; ++h) nrow[h] = -1;
+        }
       }
     }
   }

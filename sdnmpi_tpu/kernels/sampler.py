@@ -1,0 +1,211 @@
+"""Fused multi-hop path sampling as a Pallas TPU kernel.
+
+The XLA sampler (oracle/dag.sample_paths_dense) scans hop-by-hop over
+the whole flow batch; every hop materializes several ``[F, V]``
+intermediates in HBM (log-weight rows, hash noise, Gumbel scores) —
+~1.2 GB of traffic per hop for an alltoall batch, which makes sampling
+the dominant stage of ``route_collective``.
+
+This kernel tiles the *flows*: each grid program owns a ``[B]`` strip,
+keeps the log-weight matrix (bf16, ~2 MB for V=1024) and its strip of
+the destination-distance matrix in VMEM, and runs ALL hops on-chip —
+the per-hop one-hot matmul hits the MXU from VMEM, the hash/Gumbel/
+argmax chain lives in registers, and the only HBM traffic is one read
+of each input strip plus a single packed int32 write per flow (all
+sampled slots byte-packed into one word). Same hash chain and argmax
+ordering as the XLA sampler, so interpret mode matches it exactly.
+
+Supports up to 4 sampled hops per flow (4 slot bytes per int32 word) —
+with forced-final-hop elision (oracle/dag.sampled_hops) that covers
+every topology of diameter <= 5; larger diameters fall back to the XLA
+sampler.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+#: flows per grid program: lane-aligned, and [B, V] bf16 temporaries
+#: (~6 of them at V=1024) plus the [V, V] bf16 log-weights fit VMEM
+_BLOCK = 256
+_UNREACH = 16384.0
+_NO_LINK = -1e3  # candidates must exceed this (log-weight floor marker)
+
+
+def sampler_supported(v: int, hops: int, platform: str | None = None) -> bool:
+    """TPU platform, lane-aligned V, packable hop count, VMEM fit."""
+    if not _HAS_PLTPU:
+        return False
+    if platform is None:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return False
+    if v % 128 != 0 or not (1 <= hops <= 4):
+        return False
+    # lw [V, V] bf16 + ~8 strips of [B, V] bf16/f32
+    return 2 * v * v + 8 * _BLOCK * v * 4 <= 12 * 1024 * 1024
+
+
+def _hash_u32(x):
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _sampler_kernel(lw_ref, d2t_ref, src_ref, dst_ref, out_ref, *,
+                    hops: int, salt: int, block: int):
+    """One grid program: all sampled hops for ``block`` flows.
+
+    The per-flow scalar arrays (src, dst, packed output) ride as
+    full-array VMEM blocks (constant index map — loaded once, shared by
+    all programs) indexed dynamically by program id, because a
+    (1, block) strip violates the TPU (8, 128) block-tiling rule."""
+    i = pl.program_id(0)
+    v = lw_ref.shape[1]
+    lw = lw_ref[:]  # [V, V] bf16 log-weights, -1e4 = no link
+    d2t = d2t_ref[:].astype(jnp.float32)  # [B, V] distance-to-own-dst
+    src = src_ref[pl.ds(i, 1), :].reshape(block, 1)  # [B, 1] int32
+    dst = dst_ref[pl.ds(i, 1), :].reshape(block, 1)
+
+    iota_v = jax.lax.broadcasted_iota(jnp.int32, (block, v), 1)
+    fid = (
+        jax.lax.broadcasted_iota(jnp.uint32, (block, 1), 0)
+        + jnp.uint32(i * block)
+    )
+
+    # alive: real endpoints and reachable (distance via masked row-max,
+    # mirroring sample_paths_dense's dsrc gather)
+    src_oh = iota_v == jnp.maximum(src, 0)
+    dsrc = jnp.max(jnp.where(src_oh, d2t, -1.0), axis=1, keepdims=True)
+    alive0 = (src >= 0) & (dst >= 0) & (dsrc < _UNREACH)
+    node0 = jnp.where(alive0, src, -1)
+
+    def hop(h, carry):
+        node, packed = carry
+        moving = (node >= 0) & (node != dst)  # [B, 1]
+        oh = (iota_v == jnp.maximum(node, 0)).astype(jnp.bfloat16)
+        lwrow = jnp.dot(
+            oh, lw, preferred_element_type=jnp.float32
+        )  # [B, V] log w out of node (MXU)
+        arow = lwrow > _NO_LINK
+        dcur = jnp.max(
+            jnp.where(iota_v == jnp.maximum(node, 0), d2t, -1.0),
+            axis=1, keepdims=True,
+        )
+        cand = arow & (d2t == dcur - 1.0)
+
+        hh = (h.astype(jnp.uint32) + 1) * jnp.uint32(0x9E3779B1) + jnp.uint32(
+            salt & 0xFFFFFFFF
+        )
+        u = _hash_u32(
+            (fid * jnp.uint32(2654435761))
+            ^ (iota_v.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+            ^ hh
+        )
+        # uniform (0, 1) via mantissa bitcast (Mosaic has no uint32 ->
+        # f32 convert): 1.mantissa in [1, 2) minus 1; low bit forced so
+        # un > 0. Identical construction in the XLA sampler (parity).
+        bits = jnp.uint32(0x3F800000) | (u >> 9) | jnp.uint32(1)
+        un = jax.lax.bitcast_convert_type(bits, jnp.float32) - 1.0
+        gumbel = -jnp.log(-jnp.log(un))
+        score = jnp.where(cand, lwrow + gumbel, -jnp.inf)
+        nxt = jnp.argmax(score, axis=1).astype(jnp.int32).reshape(block, 1)
+        has = jnp.any(cand, axis=1).reshape(block, 1)
+
+        slot = jnp.sum(
+            (arow & (iota_v < nxt)).astype(jnp.int32), axis=1
+        ).reshape(block, 1)
+
+        ok = moving & has
+        nxt = jnp.where(ok, nxt, -1)
+        slot = jnp.where(ok, slot, -1)
+        # byte-pack: slot byte h of the word (0xFF encodes -1)
+        byte = jnp.where(slot >= 0, slot, 255).astype(jnp.int32) & 255
+        packed = packed | (byte << (8 * h))
+        return nxt, packed
+
+    packed0 = jnp.zeros((block, 1), jnp.int32)
+    _, packed = jax.lax.fori_loop(0, hops, hop, (node0, packed0))
+    out_ref[pl.ds(i, 1), :] = packed.reshape(1, block)
+
+
+@functools.partial(jax.jit, static_argnames=("hops", "salt", "interpret"))
+def sample_slots_pallas(
+    weights: jax.Array,  # [V, V] f32 split weights (0 = no link)
+    dist: jax.Array,  # [V, V] f32 hop distances
+    src: jax.Array,  # [F] int32 (-1 pad)
+    dst: jax.Array,  # [F] int32
+    hops: int,
+    salt: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Sampled slot streams, [F, hops] int8 — drop-in for the slots
+    output of ``sample_paths_dense(weights, dist, src, dst, hops)``.
+
+    F is padded to the block size internally; V must be lane-aligned
+    (see ``sampler_supported``).
+    """
+    v = weights.shape[0]
+    f = src.shape[0]
+    block = _BLOCK
+    f_pad = ((f + block - 1) // block) * block
+    pad = f_pad - f
+
+    lw = jnp.where(
+        weights > 0.0, jnp.log(jnp.maximum(weights, 1e-30)), -1e4
+    ).astype(jnp.bfloat16)
+    dist_t = jnp.where(jnp.isfinite(dist), dist, _UNREACH).T.astype(jnp.bfloat16)
+
+    src_p = jnp.concatenate([src, jnp.full((pad,), -1, jnp.int32)])
+    dst_p = jnp.concatenate([dst, jnp.full((pad,), -1, jnp.int32)])
+    # distance-to-own-destination strip: one bf16 matmul for the batch
+    oh_dst = jax.nn.one_hot(jnp.maximum(dst_p, 0), v, dtype=jnp.bfloat16)
+    d2t = (oh_dst @ dist_t).astype(jnp.bfloat16)  # [F_pad, V]
+
+    nb = f_pad // block
+    src2 = src_p.reshape(nb, block)
+    dst2 = dst_p.reshape(nb, block)
+
+    kernel = functools.partial(
+        _sampler_kernel, hops=hops, salt=salt, block=block
+    )
+    kwargs = {}
+    if _HAS_PLTPU and not interpret:
+        vm = lambda *s: pl.BlockSpec(s[0], s[1], memory_space=pltpu.VMEM)  # noqa: E731
+    else:
+        vm = lambda *s: pl.BlockSpec(s[0], s[1])  # noqa: E731
+    packed = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((nb, block), jnp.int32),
+        grid=(nb,),
+        in_specs=[
+            vm((v, v), lambda i: (0, 0)),
+            vm((block, v), lambda i: (i, 0)),
+            vm((nb, block), lambda i: (0, 0)),  # full array, see kernel
+            vm((nb, block), lambda i: (0, 0)),
+        ],
+        out_specs=vm((nb, block), lambda i: (0, 0)),
+        interpret=interpret,
+        **kwargs,
+    )(lw, d2t, src2, dst2)
+
+    words = packed.reshape(f_pad)[:f]  # [F] int32
+    shifts = jnp.arange(hops, dtype=jnp.int32) * 8
+    bytes_ = (words[:, None] >> shifts[None, :]) & 255
+    return jnp.where(bytes_ == 255, -1, bytes_).astype(jnp.int8)

@@ -56,7 +56,9 @@ def _load() -> Optional[ctypes.CDLL]:
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i64 = ctypes.c_int64
-        lib.decode_slots.argtypes = [i8p, i32p, i32p, i32p, i64, i64, i64, i64, i32p]
+        lib.decode_slots.argtypes = [
+            i8p, i32p, i32p, i32p, i64, i64, i64, i64, ctypes.c_int32, i32p,
+        ]
         lib.decode_slots.restype = None
         lib.link_loads.argtypes = [i32p, f32p, i64, i64, i64, f32p]
         lib.link_loads.restype = None
@@ -90,9 +92,17 @@ def neighbor_order(adj: np.ndarray) -> np.ndarray:
 
 
 def decode_slots(
-    slots: np.ndarray, order: np.ndarray, src: np.ndarray, dst: np.ndarray
+    slots: np.ndarray,
+    order: np.ndarray,
+    src: np.ndarray,
+    dst: np.ndarray,
+    complete: bool = False,
 ) -> np.ndarray:
-    """slots [F, L] int8 + sorted-neighbor table -> nodes [F, L] int32."""
+    """slots [F, L] int8 + sorted-neighbor table -> nodes int32.
+
+    ``complete=True`` appends the forced final hop (dag.sampled_hops
+    contract): output [F, L + 2], whole row -1 when the walk ends not
+    adjacent to dst. ``complete=False``: raw [F, L] walk."""
     lib = _load()
     slots = np.ascontiguousarray(slots, np.int8)
     order = np.ascontiguousarray(order, np.int32)
@@ -100,12 +110,13 @@ def decode_slots(
     dst = np.ascontiguousarray(dst, np.int32)
     f, l = slots.shape
     v, d = order.shape
+    out_l = l + 2 if complete else l
     if l == 0:
-        return np.empty((f, 0), np.int32)
+        return np.empty((f, out_l), np.int32)
     if lib is None:  # numpy fallback, identical semantics
         s32 = slots.astype(np.int32)
         valid = (s32[:, 0] >= 0) | (src == dst)
-        nodes = np.full((f, l), -1, np.int32)
+        nodes = np.full((f, out_l), -1, np.int32)
         node = np.where(valid & (src >= 0), src, -1)
         for h in range(l):
             nodes[:, h] = node
@@ -113,9 +124,17 @@ def decode_slots(
             ok = (s >= 0) & (node >= 0) & (s < d)
             nxt = order[np.maximum(node, 0), np.maximum(np.minimum(s, d - 1), 0)]
             node = np.where(ok & (nxt < v), nxt, -1)
+        if complete:
+            nodes[:, l] = node
+            need = (node >= 0) & (node != dst)
+            adjacent = (
+                order[np.maximum(node, 0)] == dst[:, None]
+            ).any(axis=1)
+            nodes[need & adjacent, l + 1] = dst[need & adjacent]
+            nodes[need & ~adjacent] = -1
         return nodes
-    nodes = np.empty((f, l), np.int32)
-    lib.decode_slots(slots, order, src, dst, f, l, v, d, nodes)
+    nodes = np.empty((f, out_l), np.int32)
+    lib.decode_slots(slots, order, src, dst, f, l, v, d, int(complete), nodes)
     return nodes
 
 

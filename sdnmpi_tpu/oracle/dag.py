@@ -250,7 +250,14 @@ def sample_paths_dense(
     """
     v = weights.shape[0]
     f = src.shape[0]
-    w_bf = weights.astype(jnp.bfloat16)
+    # log-weights precomputed ONCE: the per-hop matmul then extracts
+    # log w rows directly, so no [F, V] log runs inside the scan. -1e4
+    # marks "no link" (finite: 0 * -1e4 = 0 keeps the one-hot matmul
+    # NaN-free, and any real log-weight is > -1e3)
+    no_link = -1e4
+    lw_bf = jnp.where(
+        weights > 0.0, jnp.log(jnp.maximum(weights, 1e-30)), no_link
+    ).astype(jnp.bfloat16)
     # inf would produce 0 * inf = NaN under the one-hot matmul; 2^14 is
     # exact in bf16 and larger than any real hop count
     unreach = 16384.0
@@ -269,8 +276,8 @@ def sample_paths_dense(
     def hop(node, h):
         moving = (node >= 0) & (node != dst)
         oh = jax.nn.one_hot(jnp.maximum(node, 0), v, dtype=jnp.bfloat16)
-        wrow = (oh @ w_bf).astype(jnp.float32)  # [F, V] weights out of node
-        arow = wrow > 0.0
+        lwrow = (oh @ lw_bf).astype(jnp.float32)  # [F, V] log w out of node
+        arow = lwrow > -1e3  # real links only (no-link marker is -1e4)
         dcur = jnp.take_along_axis(
             d2t, jnp.maximum(node, 0)[:, None], axis=1
         )  # [F, 1]
@@ -285,9 +292,12 @@ def sample_paths_dense(
             ^ (iota[None, :].astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
             ^ hh
         )
-        un = (u.astype(jnp.float32) + 1.0) / 4294967296.0
+        # uniform (0, 1) via mantissa bitcast — bit-identical to the
+        # Pallas sampler (kernels/sampler.py) so both paths agree
+        bits = jnp.uint32(0x3F800000) | (u >> 9) | jnp.uint32(1)
+        un = lax.bitcast_convert_type(bits, jnp.float32) - 1.0
         gumbel = -jnp.log(-jnp.log(un))
-        score = jnp.where(cand, jnp.log(jnp.maximum(wrow, 1e-30)) + gumbel, -INF)
+        score = jnp.where(cand, lwrow + gumbel, -INF)
         nxt = jnp.argmax(score, axis=1).astype(jnp.int32)
         has = jnp.any(cand, axis=1)
 
@@ -307,14 +317,34 @@ def sample_paths_dense(
     return jnp.swapaxes(nodes, 0, 1), jnp.swapaxes(slots, 0, 1)
 
 
-def slots_to_nodes(adj, src, slots, dst=None):
+def sampled_hops(max_len: int) -> int:
+    """Slot-stream width ``route_collective`` actually samples.
+
+    A shortest path of P <= max_len - 1 edges has free (multi-candidate)
+    decisions only at hops 0..P-2 — the hop *into* the destination is
+    forced (at distance 1 the only shortest-path candidate is dst). So
+    ``max_len - 2`` sampled decisions cover every free choice of every
+    flow; the decoder re-adds the forced final hop. This cuts the most
+    expensive device stage (per-hop [F, V] one-hot matmuls) and the
+    readback bytes by 2/max_len (~40% for diameter-4 fat-trees).
+    """
+    return max(1, max_len - 2)
+
+
+def slots_to_nodes(adj, src, slots, dst=None, complete=False):
     """Host-side decode of the compact slot form back to switch indices.
 
     ``adj`` [V, V] array-like, ``src``/``dst`` [F] int32, ``slots``
-    [F, L] int8. Mirrors the device's sorted-neighbor table; returns
-    [F, L] int32 nodes padded with -1 (numpy, no device involved).
-    ``dst`` distinguishes a src==dst flow (path = [src]) from an
-    unreachable one (all -1) — both have an all--1 slot stream.
+    [F, H] int8. Mirrors the device's sorted-neighbor table; returns
+    int32 nodes padded with -1 (numpy, no device involved). ``dst``
+    distinguishes a src==dst flow (path = [src]) from an unreachable
+    one (all -1) — both have an all--1 slot stream.
+
+    ``complete=True`` (the ``route_collective`` readback contract, see
+    :func:`sampled_hops`) appends the forced final hop: after walking
+    the H sampled slots, a flow whose last node is a neighbor of its
+    dst but not yet dst gets dst appended; output is [F, H + 2].
+    With ``complete=False`` output is [F, H] (raw walk, legacy shape).
 
     Dispatches to the C++ decoder (sdnmpi_tpu/native.py) when the
     shared library is available; this numpy body is the fallback and
@@ -323,16 +353,19 @@ def slots_to_nodes(adj, src, slots, dst=None):
     import numpy as np
 
     src = np.asarray(src, np.int32)
+    if complete and dst is None:
+        raise ValueError("slots_to_nodes(complete=True) requires dst")
     if dst is not None:
+        # single implementation of the walk + completion semantics:
+        # native.decode_slots (C++ when built, numpy fallback otherwise)
         from sdnmpi_tpu import native
 
-        if native.available():
-            order = native.neighbor_order(adj)
-            return native.decode_slots(
-                np.asarray(slots, np.int8), order, src,
-                np.asarray(dst, np.int32),
-            )
+        return native.decode_slots(
+            np.asarray(slots, np.int8), native.neighbor_order(adj),
+            src, np.asarray(dst, np.int32), complete=complete,
+        )
 
+    # legacy dst-less walk (cannot distinguish src==dst from dead flows)
     a = np.asarray(adj) > 0
     v = a.shape[0]
     order = np.where(a, np.arange(v)[None, :], v)
@@ -340,8 +373,6 @@ def slots_to_nodes(adj, src, slots, dst=None):
     slots = np.asarray(slots, np.int32)
     f, l = slots.shape
     valid = (slots[:, 0] >= 0) | (src >= 0)
-    if dst is not None:
-        valid = (slots[:, 0] >= 0) | (src == np.asarray(dst, np.int32))
     nodes = np.full((f, l), -1, np.int32)
     node = np.where(valid, src, -1)
     for h in range(l):
@@ -369,14 +400,19 @@ def route_collective(
     max_len: int,
     max_degree: int,
     salt: int = 0,
+    dist: jax.Array | None = None,
 ) -> jax.Array:
     """End-to-end collective routing, one device program, one output.
 
     Scatters the compact per-link utilization vector into the [V, V]
-    cost matrix (unique indices — fast), runs APSP fresh, balances the
+    cost matrix (unique indices — fast), runs APSP (or reuses the
+    caller's ``dist`` — distances depend only on the topology, not on
+    utilization, so steady-state callers pass the matrix cached at the
+    current topology version and skip the BFS entirely), balances the
     collective over the DAG, samples every flow's discrete path, and
-    packs ``slots`` (int8 [F * max_len]) + the bitcast f32 max-link
-    congestion into ONE int8 buffer so the host pays a single fetch.
+    packs ``slots`` (int8 [F * sampled_hops(max_len)]) + the bitcast
+    f32 max-link congestion into ONE int8 buffer so the host pays a
+    single fetch.
 
     PRECONDITION: ``levels`` must upper-bound the graph diameter. On
     TPU the fused Pallas BFS runs exactly ``levels`` steps, so pairs
@@ -396,16 +432,27 @@ def route_collective(
         .at[link_src, link_dst]
         .set(link_util, unique_indices=True, mode="drop")
     )
-    # fused VMEM-resident BFS on TPU (levels is the static diameter
-    # bound); XLA while_loop formulation elsewhere
-    if pallas_supported(v):
-        dist = bfs_distances_pallas(adj, levels=levels)
-    else:
-        dist = apsp_distances(adj)
+    if dist is None:
+        # fused VMEM-resident BFS on TPU (levels is the static diameter
+        # bound); XLA while_loop formulation elsewhere
+        if pallas_supported(v):
+            dist = bfs_distances_pallas(adj, levels=levels)
+        else:
+            dist = apsp_distances(adj)
     weights, _, maxc = balance_rounds(
         adj, dist, base, traffic, levels=levels, rounds=rounds
     )
-    _, slots = sample_paths_dense(weights, dist, src, dst, max_len, salt=salt)
+    # only the free decisions are sampled on device; the forced final
+    # hop is re-added by the decoder (sampled_hops) — cuts the dominant
+    # [F, V] per-hop stage and the readback bytes by 2/max_len
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+
+    hops = sampled_hops(max_len)
+    if sampler_supported(v, hops):
+        # fused VMEM-resident sampler: all hops on-chip per flow strip
+        slots = sample_slots_pallas(weights, dist, src, dst, hops, salt=salt)
+    else:
+        _, slots = sample_paths_dense(weights, dist, src, dst, hops, salt=salt)
     tail = lax.bitcast_convert_type(maxc[None], jnp.int8).reshape(-1)
     return jnp.concatenate([slots.reshape(-1), tail])
 
@@ -413,11 +460,14 @@ def route_collective(
 def unpack_result(buf, n_flows: int, max_len: int):
     """Host-side split of route_collective's packed buffer.
 
-    Returns (slots [F, max_len] int8 numpy, max_congestion float).
+    Returns (slots [F, sampled_hops(max_len)] int8 numpy, max_congestion
+    float). Decode the slots with ``slots_to_nodes(..., complete=True)``
+    to recover full [F, max_len] paths.
     """
     import numpy as np
 
+    hops = sampled_hops(max_len)
     host = np.asarray(buf)
-    slots = host[: n_flows * max_len].reshape(n_flows, max_len)
-    maxc = float(host[n_flows * max_len :].view(np.float32)[0])
+    slots = host[: n_flows * hops].reshape(n_flows, hops)
+    maxc = float(host[n_flows * hops :].view(np.float32)[0])
     return slots, maxc

@@ -166,6 +166,75 @@ class TestSamplePaths:
         assert np.array_equal(decoded, np.asarray(nodes))
 
 
+class TestForcedHopElision:
+    """route_collective samples only sampled_hops(max_len) decisions;
+    the decoder re-adds the forced hop into the destination."""
+
+    def test_all_path_lengths_decode_complete(self, diamond_tensors):
+        from sdnmpi_tpu.oracle.dag import sampled_hops
+
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        # dist-1 (0->1), dist-2 (0->3), self (2->2), unreachable pad (-1)
+        src = jnp.asarray(np.array([0, 0, 2, -1], np.int32))
+        dst = jnp.asarray(np.array([1, 3, 2, 3], np.int32))
+        max_len = 4
+        from sdnmpi_tpu.oracle.dag import sample_paths_dense
+
+        _, slots = sample_paths_dense(
+            adj_f, dist, src, dst, sampled_hops(max_len)
+        )
+        nodes = slots_to_nodes(
+            t.adj, np.asarray(src), np.asarray(slots), np.asarray(dst),
+            complete=True,
+        )
+        assert nodes.shape == (4, sampled_hops(max_len) + 2)
+        p0 = nodes[0][nodes[0] >= 0]
+        assert list(p0) == [0, 1]
+        p1 = nodes[1][nodes[1] >= 0]
+        assert p1[0] == 0 and p1[-1] == 3 and len(p1) == 3
+        assert list(nodes[2][nodes[2] >= 0]) == [2]
+        assert (nodes[3] == -1).all()
+
+    def test_truncated_walk_not_fabricated(self, diamond_tensors):
+        """If the sampled walk ends NOT adjacent to dst (precondition
+        violated), the decoder must refuse rather than invent a link."""
+        t, _ = diamond_tensors
+        # hand-craft: a single sampled hop for the 2-hop pair 0->3 ends
+        # at switch index 1 in a topology where we then cut link 1->3
+        adj = np.asarray(t.adj).copy()
+        adj[1, 3] = 0.0  # decoder's adjacency says 1-/->3
+        slots = np.array([[0]], np.int8)  # 0 -> first neighbor (1)
+        nodes = slots_to_nodes(
+            adj, np.array([0], np.int32), slots, np.array([3], np.int32),
+            complete=True,
+        )
+        assert (nodes[0] == -1).all()
+
+    def test_native_and_numpy_completion_agree(self, diamond_tensors):
+        import sdnmpi_tpu.native as nat
+
+        t, dist = diamond_tensors
+        adj_f = (t.adj > 0).astype(jnp.float32)
+        rng = np.random.default_rng(5)
+        src = rng.integers(0, 4, 64).astype(np.int32)
+        dst = rng.integers(0, 4, 64).astype(np.int32)
+        from sdnmpi_tpu.oracle.dag import sample_paths_dense
+
+        _, slots = sample_paths_dense(
+            adj_f, dist, jnp.asarray(src), jnp.asarray(dst), 2
+        )
+        order = nat.neighbor_order(np.asarray(t.adj))
+        got = nat.decode_slots(np.asarray(slots), order, src, dst, complete=True)
+        lib, tried = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        try:
+            fb = nat.decode_slots(np.asarray(slots), order, src, dst, complete=True)
+        finally:
+            nat._lib, nat._tried = lib, tried
+        np.testing.assert_array_equal(got, fb)
+
+
 class TestRouteCollective:
     def test_end_to_end_packed(self, diamond_tensors):
         t, dist = diamond_tensors
@@ -183,7 +252,7 @@ class TestRouteCollective:
             levels=2, rounds=2, max_len=4, max_degree=t.max_degree,
         )
         slots, maxc = unpack_result(buf, 3, 4)
-        nodes = slots_to_nodes(adj, src, slots, dst)
+        nodes = slots_to_nodes(adj, src, slots, dst, complete=True)
         for f in range(3):
             path = nodes[f][nodes[f] >= 0]
             assert path[0] == src[f] and path[-1] == dst[f]

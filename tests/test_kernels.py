@@ -55,6 +55,51 @@ def test_pallas_supported_gating():
     assert not pallas_supported(4096)  # adjacency alone exceeds VMEM budget
 
 
+class TestSamplerKernel:
+    """The fused Pallas sampler must agree bit-for-bit with the XLA
+    sampler (route_collective switches between them by platform)."""
+
+    @pytest.fixture(scope="class")
+    def problem(self):
+        from sdnmpi_tpu.oracle.dag import balance_rounds
+
+        db = fattree(8).to_topology_db(backend="jax")
+        t = tensorize(db, pad_multiple=128)
+        dist = apsp_distances(t.adj)
+        v = t.adj.shape[0]
+        # non-uniform weights (a balanced round) so the log-weight and
+        # Gumbel paths are exercised, not just uniform ties
+        traffic = jnp.zeros((v, v), jnp.float32).at[5, 0].set(100.0)
+        weights, _, _ = balance_rounds(
+            t.adj, dist, jnp.zeros((v, v)), traffic, levels=4, rounds=2
+        )
+        rng = np.random.default_rng(3)
+        f = 700  # not a block multiple: exercises padding
+        src = jnp.asarray(rng.integers(-1, t.n_real, f).astype(np.int32))
+        dst = jnp.asarray(rng.integers(0, t.n_real, f).astype(np.int32))
+        return t, dist, weights, src, dst
+
+    @pytest.mark.parametrize("hops", [1, 2, 3, 4])
+    def test_bit_parity_with_xla_sampler(self, problem, hops):
+        from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
+        from sdnmpi_tpu.oracle.dag import sample_paths_dense
+
+        t, dist, weights, src, dst = problem
+        _, ref = sample_paths_dense(weights, dist, src, dst, hops, salt=9)
+        got = sample_slots_pallas(
+            weights, dist, src, dst, hops, salt=9, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_sampler_supported_gating(self):
+        from sdnmpi_tpu.kernels.sampler import sampler_supported
+
+        assert not sampler_supported(1000, 3)  # not lane-aligned
+        assert not sampler_supported(1024, 5)  # > 4 packable hops
+        assert not sampler_supported(1024, 0)
+        assert not sampler_supported(1024, 3, platform="cpu")
+
+
 def test_pick_block_divides_and_fits():
     for v in (128, 256, 512, 1024):
         b = _pick_block(v)
