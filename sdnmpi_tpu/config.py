@@ -60,6 +60,16 @@ class Config:
     proactive_collectives: bool = True
     #: device chunk size for the balanced-routing scan
     ecmp_chunk: int = 4096
+    #: routing policy for proactive collective batches: "balanced"
+    #: (load-aware ECMP — right for fat-trees) or "adaptive" (UGAL
+    #: min/non-min — right for low-diameter topologies like dragonfly)
+    #: or "shortest" (deterministic next-hop paths)
+    collective_policy: Literal["balanced", "adaptive", "shortest"] = "balanced"
+    #: UGAL: Valiant intermediate candidates sampled per flow
+    ugal_candidates: int = 4
+    #: UGAL: detour hysteresis — a detour must beat the minimal DAG cost
+    #: by more than this to be taken (idle fabrics route 100% minimal)
+    ugal_bias: float = 1.0
 
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves

@@ -132,9 +132,13 @@ class FindAllRoutesReply(Reply):
 class FindRoutesBatchRequest(Request):
     dst = "TopologyManager"
     pairs: list  # [(src_mac, dst_mac), ...]
-    #: spread the batch across equal-cost paths, seeded with the measured
-    #: link utilization the Monitor has been feeding the TopologyManager
-    balanced: bool = False
+    #: routing policy for the batch:
+    #: - "shortest": deterministic next-hop paths (cached APSP)
+    #: - "balanced": load-aware ECMP spread, seeded with the measured
+    #:   link utilization the Monitor feeds the TopologyManager
+    #: - "adaptive": UGAL min/non-min — flows may detour through a
+    #:   Valiant intermediate when the minimal DAG is congested
+    policy: str = "shortest"
 
 
 @dataclasses.dataclass

@@ -234,7 +234,9 @@ class Router:
         if not pairs:
             return
 
-        reply = self.bus.request(ev.FindRoutesBatchRequest(pairs, balanced=True))
+        reply = self.bus.request(
+            ev.FindRoutesBatchRequest(pairs, policy=self.config.collective_policy)
+        )
         log.info(
             "proactive install: collective %s, %d flows, max link load %s",
             vmac.coll_type,

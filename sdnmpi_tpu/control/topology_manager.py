@@ -114,7 +114,7 @@ class TopologyManager:
     def _find_routes_batch(
         self, req: ev.FindRoutesBatchRequest
     ) -> ev.FindRoutesBatchReply:
-        if req.balanced:
+        if req.policy == "balanced":
             fdbs, max_congestion = self.topologydb.find_routes_batch_balanced(
                 req.pairs,
                 link_util=self.link_util,
@@ -124,6 +124,26 @@ class TopologyManager:
                 ecmp_ways=self.config.ecmp_ways,
             )
             return ev.FindRoutesBatchReply(fdbs, max_congestion)
+        if req.policy == "adaptive":
+            fdbs, n_detours, max_congestion = (
+                self.topologydb.find_routes_batch_adaptive(
+                    req.pairs,
+                    link_util=self.link_util,
+                    ugal_candidates=self.config.ugal_candidates,
+                    ugal_bias=self.config.ugal_bias,
+                    alpha=self.config.congestion_alpha,
+                    link_capacity=self.config.link_capacity_bps,
+                    ecmp_ways=self.config.ecmp_ways,
+                )
+            )
+            if n_detours:
+                log.info("UGAL detoured %d of %d pairs", n_detours, len(req.pairs))
+            return ev.FindRoutesBatchReply(fdbs, max_congestion)
+        if req.policy != "shortest":
+            log.warning(
+                "unknown routing policy %r: falling back to shortest-path",
+                req.policy,
+            )
         return ev.FindRoutesBatchReply(self.topologydb.find_routes_batch(req.pairs))
 
     def _broadcast_request(self, req: ev.BroadcastRequest) -> ev.BroadcastReply:

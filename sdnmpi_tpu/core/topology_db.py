@@ -266,6 +266,38 @@ class TopologyDB:
                 load[(a, b)] = load.get((a, b), 0.0) + 1.0
         return fdbs, max(load.values(), default=0.0)
 
+    def find_routes_batch_adaptive(
+        self,
+        pairs: list[tuple[str, str]],
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        ugal_candidates: int = 4,
+        ugal_bias: float = 1.0,
+        alpha: float = 1.0,
+        link_capacity: float = 10e9,
+        ecmp_ways: int = 4,
+    ) -> tuple[list[list[tuple[int, int]]], int]:
+        """UGAL adaptive min/non-min batched routing (oracle/adaptive.py):
+        flows may detour through a Valiant intermediate when measured
+        congestion makes their hop-minimal routes expensive — the right
+        policy on low-diameter topologies (dragonfly). Returns
+        ``(fdbs, n_detoured_pairs, max_congestion)``.
+
+        The pure-Python backend has no adaptive machinery; it degrades
+        to the plain batch with zero detours.
+        """
+        if self.backend == "jax":
+            return self._jax_oracle().routes_batch_adaptive(
+                self,
+                pairs,
+                link_util=link_util,
+                ugal_candidates=ugal_candidates,
+                ugal_bias=ugal_bias,
+                alpha=alpha,
+                link_capacity=link_capacity,
+                ecmp_ways=ecmp_ways,
+            )
+        return [self.find_route(s, d) for s, d in pairs], 0, 0.0
+
     # -- backend dispatch ------------------------------------------------
 
     def _shortest_route(self, src_dpid: int, dst_dpid: int) -> list[int]:

@@ -455,8 +455,10 @@ class RouteOracle:
         (edge, edge) transit are split into up to ``ecmp_ways`` weighted
         sub-flows (distinct hash streams -> distinct sampled paths), so
         intra-group ECMP spreading is preserved alongside the UGAL
-        choice. Returns ``(fdbs, n_detoured_pairs)`` — the number of
-        input pairs whose installed route takes a Valiant detour.
+        choice. Returns ``(fdbs, n_detoured_pairs, max_congestion)`` —
+        the number of input pairs whose installed route takes a Valiant
+        detour, and the max fractional link load of the balanced
+        assignment.
         """
         from sdnmpi_tpu.oracle.adaptive import route_adaptive, stitch_paths
 
@@ -464,19 +466,19 @@ class RouteOracle:
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
         rows = self._resolve_rows(db, pairs, t, results)
         if not rows:
-            return results, 0
+            return results, 0, 0.0
 
         groups, group_subs, src_idx, dst_idx, weight = self._group_ecmp_subflows(
             rows, ecmp_ways
         )
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
-            return results, 0
+            return results, 0, 0.0
         levels = max_len - 1
 
         base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
 
-        inter, n1, n2, _ = route_adaptive(
+        inter, n1, n2, load = route_adaptive(
             t.adj,
             jnp.asarray(base.astype(np.float32)),
             jnp.asarray(src_idx),
@@ -495,7 +497,9 @@ class RouteOracle:
         inter_h = np.asarray(inter)
         installed = self._materialize_fdbs(t, groups, group_subs, paths, results)
         n_detours = sum(1 for _, g in installed if inter_h[g] >= 0)
-        return results, n_detours
+        adj_mask = np.asarray(t.adj) > 0
+        maxc = float(np.asarray(load).max(initial=0.0, where=adj_mask))
+        return results, n_detours, maxc
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------
 

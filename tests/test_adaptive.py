@@ -251,7 +251,7 @@ class TestEngineAdaptive:
         oracle = RouteOracle()
         macs = sorted(db.hosts)[:8]
         pairs = [(a, b) for a in macs for b in macs if a != b]
-        fdbs, n_detours = oracle.routes_batch_adaptive(db, pairs)
+        fdbs, n_detours, _ = oracle.routes_batch_adaptive(db, pairs)
         assert n_detours == 0  # idle fabric: UGAL stays minimal
         plain = oracle.routes_batch(db, pairs)
         for (a, b), fdb, ref in zip(pairs, fdbs, plain):
@@ -284,10 +284,11 @@ class TestEngineAdaptive:
             m for m in sorted(db.hosts) if 5 <= db.hosts[m].port.dpid <= 8
         ]
         pairs = [(a, b) for a in g0 for b in g1]
-        fdbs, n_detours = oracle.routes_batch_adaptive(
+        fdbs, n_detours, maxc = oracle.routes_batch_adaptive(
             db, pairs, link_util=link_util, ugal_candidates=8
         )
         assert n_detours > 0
+        assert maxc > 0.0  # congestion figure is reported, not dropped
         for fdb in fdbs:
             assert fdb  # every pair still routed
 
@@ -306,7 +307,7 @@ class TestEngineAdaptive:
         g0 = [m for m in sorted(db.hosts) if db.hosts[m].port.dpid == a_sw]
         g1 = [m for m in sorted(db.hosts) if db.hosts[m].port.dpid == b_sw]
         pairs = [(a, b) for a in g0 for b in g1]  # 16 pairs, one transit
-        fdbs, _ = oracle.routes_batch_adaptive(db, pairs, ecmp_ways=4)
+        fdbs, _, _ = oracle.routes_batch_adaptive(db, pairs, ecmp_ways=4)
         transits = {tuple(d for d, _ in fdb) for fdb in fdbs}
         assert len(transits) > 1, f"all 16 pairs on one path: {transits}"
 

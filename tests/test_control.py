@@ -227,6 +227,30 @@ class TestMpiRouting:
         ), "stale MPI flow left on switch"
 
 
+class TestAdaptivePolicy:
+    def test_proactive_collective_with_ugal_policy(self):
+        """collective_policy="adaptive" routes the whole collective
+        through the UGAL oracle and still installs working flows."""
+        fabric = make_diamond()
+        controller = Controller(
+            fabric, Config(oracle_backend="jax", collective_policy="adaptive")
+        )
+        controller.attach()
+        for i, rank in ((1, 0), (2, 1), (3, 2), (4, 3)):
+            announce(fabric, MAC[i], AnnouncementType.LAUNCH, rank)
+        vmac01 = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], vmac01))
+        assert fabric.hosts[MAC[2]].received[0].eth_dst == MAC[2]
+        for s in range(4):
+            for d in range(4):
+                if s == d:
+                    continue
+                pair_vmac = VirtualMac(CollectiveType.ALLTOALL, s, d).encode()
+                assert controller.router.fdb.exists_anywhere(
+                    MAC[s + 1], pair_vmac
+                ), f"missing proactive flow for rank pair {s}->{d}"
+
+
 class TestProactiveCollectives:
     def test_alltoall_preinstalls_all_rank_pairs(self, stack):
         fabric, controller = stack
