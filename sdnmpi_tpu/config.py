@@ -81,5 +81,13 @@ class Config:
     #: run the monitor app (reference: run_router_no_monitor.sh omits it)
     enable_monitor: bool = True
 
+    # --- tracing / profiling (SURVEY §5: reference has none) -------------
+    #: JSONL structured trace log path ("" = disabled); records oracle
+    #: invocations with wall times (utils/tracing.py)
+    trace_log: str = ""
+    #: jax.profiler trace output dir ("" = disabled); wraps the run in a
+    #: TensorBoard-compatible device profile
+    profile_dir: str = ""
+
 
 DEFAULT_CONFIG = Config()

@@ -99,7 +99,14 @@ async def amain(args) -> None:
         enable_monitor=args.profile != "no-monitor",
         rpc_host=args.rpc_host,
         rpc_port=args.rpc_port,
+        collective_policy=args.policy,
+        trace_log=args.trace_log or "",
+        profile_dir=args.profile_dir or "",
     )
+    if config.trace_log:
+        from sdnmpi_tpu.utils.tracing import set_trace_sink
+
+        set_trace_sink(config.trace_log)
     spec = parse_topo(args.topo)
     fabric = spec.to_fabric()
     controller = Controller(fabric, config)
@@ -126,17 +133,22 @@ async def amain(args) -> None:
         rpc = RPCInterface(controller.bus, config)
         tasks.append(asyncio.create_task(rpc.serve()))
 
-    if args.demo:
-        run_demo(controller, fabric, args.demo_ranks)
+    from sdnmpi_tpu.utils.tracing import STATS, device_trace
 
     try:
-        if args.duration > 0:
-            await asyncio.sleep(args.duration)
-        else:
-            await asyncio.Future()
+        with device_trace(config.profile_dir):
+            if args.demo:
+                run_demo(controller, fabric, args.demo_ranks)
+            if args.duration > 0:
+                await asyncio.sleep(args.duration)
+            else:
+                await asyncio.Future()
     except asyncio.CancelledError:
         pass
     finally:
+        summary = STATS.summary()
+        if summary:
+            log.info("oracle timing summary: %s", summary)
         if args.checkpoint:
             from sdnmpi_tpu.api.snapshot import save_checkpoint
 
@@ -161,6 +173,14 @@ def main(argv=None) -> None:
     parser.add_argument("--rpc-host", default="127.0.0.1")
     parser.add_argument("--rpc-port", type=int, default=8080)
     parser.add_argument("--no-rpc", action="store_true", help="disable the WebSocket mirror")
+    parser.add_argument(
+        "--policy",
+        choices=["balanced", "adaptive", "shortest"],
+        default="balanced",
+        help="routing policy for proactive collective batches",
+    )
+    parser.add_argument("--trace-log", help="JSONL structured trace log path")
+    parser.add_argument("--profile-dir", help="jax.profiler trace output dir")
     parser.add_argument("--demo", action="store_true", help="generate demo MPI traffic")
     parser.add_argument("--demo-ranks", type=int, default=8)
     parser.add_argument("--duration", type=float, default=0, help="run time in seconds (0 = forever)")

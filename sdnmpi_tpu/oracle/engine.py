@@ -18,6 +18,22 @@ import numpy as np
 
 from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
 from sdnmpi_tpu.oracle.paths import batch_fdb
+from sdnmpi_tpu.utils.tracing import STATS
+
+
+def _timed_batch(op: str):
+    """Record wall time + batch size of a routes_batch* invocation."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, db, pairs, *args, **kwargs):
+            with STATS.timed(op, n_pairs=len(pairs)):
+                return fn(self, db, pairs, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 if TYPE_CHECKING:
     from sdnmpi_tpu.core.topology_db import TopologyDB
@@ -113,14 +129,15 @@ class RouteOracle:
 
     def refresh(self, db: "TopologyDB") -> TopoTensors:
         if self._version != db.version or self._tensors is None:
-            tensors = tensorize(db, self.pad_multiple)
-            dist = apsp_distances(tensors.adj, self.max_diameter)
-            nxt = apsp_next_hops(tensors.adj, dist)
-            self._tensors = tensors
-            self._dist = np.asarray(dist)
-            self._next = np.asarray(nxt)
-            self._port = np.asarray(tensors.port)  # host copy for chasing
-            self._version = db.version
+            with STATS.timed("oracle_refresh", version=db.version):
+                tensors = tensorize(db, self.pad_multiple)
+                dist = apsp_distances(tensors.adj, self.max_diameter)
+                nxt = apsp_next_hops(tensors.adj, dist)
+                self._tensors = tensors
+                self._dist = np.asarray(dist)
+                self._next = np.asarray(nxt)
+                self._port = np.asarray(tensors.port)  # host copy for chasing
+                self._version = db.version
         return self._tensors
 
     # -- queries ----------------------------------------------------------
@@ -318,6 +335,7 @@ class RouteOracle:
     #: TPU tunnel) swamps tiny batches. Large collectives amortize it.
     host_chase_hop_budget: int = 4096
 
+    @_timed_batch("routes_batch")
     def routes_batch(
         self, db: "TopologyDB", pairs: list[tuple[str, str]]
     ) -> list[list[tuple[int, int]]]:
@@ -378,6 +396,7 @@ class RouteOracle:
             ]
         return results
 
+    @_timed_batch("routes_batch_balanced")
     def routes_batch_balanced(
         self,
         db: "TopologyDB",
@@ -434,6 +453,7 @@ class RouteOracle:
         self._materialize_fdbs(t, groups, group_subs, np.asarray(nodes), results)
         return results, float(maxc)
 
+    @_timed_batch("routes_batch_adaptive")
     def routes_batch_adaptive(
         self,
         db: "TopologyDB",

@@ -1,0 +1,105 @@
+"""Oracle timing + structured trace log (SURVEY §5: the reference has no
+tracing/profiling at all; its closest artifact is INFO-level handler
+logging).
+
+Two layers:
+
+- :class:`OracleStats` — cheap always-on wall-time accounting of oracle
+  invocations (a bounded deque per operation). The controller exposes it
+  so operators can see route-compute latency percentiles without any
+  profiler attached.
+- :func:`device_trace` — optional ``jax.profiler`` trace context writing
+  a TensorBoard-compatible profile when ``Config.profile_dir`` is set;
+  a no-op otherwise (the profiler is only imported when enabled).
+
+Both emit structured JSONL records through ``trace_event`` when a sink
+is installed (``set_trace_sink``), giving the structured event log the
+reference lacks.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import pathlib
+import statistics
+import time
+from typing import Callable, Optional
+
+_sink: Optional[Callable[[dict], None]] = None
+_sink_file = None  # open handle when the sink is file-based
+
+
+def set_trace_sink(path_or_fn) -> None:
+    """Install a JSONL trace sink: a file path, a callable(dict), or
+    None to disable. Replacing a file-based sink closes its handle."""
+    global _sink, _sink_file
+    if _sink_file is not None:
+        _sink_file.close()
+        _sink_file = None
+    if path_or_fn is None:
+        _sink = None
+    elif callable(path_or_fn):
+        _sink = path_or_fn
+    else:
+        f = pathlib.Path(path_or_fn).open("a")
+        _sink_file = f
+        _sink = lambda rec: (f.write(json.dumps(rec) + "\n"), f.flush())  # noqa: E731
+
+
+def trace_event(kind: str, **fields) -> None:
+    """Emit one structured trace record (no-op without a sink)."""
+    if _sink is not None:
+        _sink({"ts": time.time(), "kind": kind, **fields})
+
+
+class OracleStats:
+    """Bounded per-operation wall-time samples with summary figures."""
+
+    def __init__(self, maxlen: int = 512) -> None:
+        self.samples: dict[str, collections.deque] = collections.defaultdict(
+            lambda: collections.deque(maxlen=maxlen)
+        )
+
+    @contextlib.contextmanager
+    def timed(self, op: str, **fields):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.samples[op].append(dt)
+            trace_event("oracle", op=op, wall_ms=round(dt * 1e3, 3), **fields)
+
+    def summary(self) -> dict[str, dict]:
+        out = {}
+        for op, xs in self.samples.items():
+            data = sorted(xs)
+            n = len(data)
+            if n == 0:  # defaultdict read-access can leave empty deques
+                continue
+            out[op] = {
+                "count": n,
+                "mean_ms": round(statistics.fmean(data) * 1e3, 3),
+                "p50_ms": round(data[n // 2] * 1e3, 3),
+                "p99_ms": round(data[min(n - 1, (99 * n) // 100)] * 1e3, 3),
+                "max_ms": round(data[-1] * 1e3, 3),
+            }
+        return out
+
+
+#: process-wide stats instance the oracle layers record into
+STATS = OracleStats()
+
+
+@contextlib.contextmanager
+def device_trace(profile_dir: Optional[str]):
+    """jax.profiler trace context; no-op when profile_dir is falsy."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(profile_dir)):
+        yield

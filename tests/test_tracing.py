@@ -1,0 +1,72 @@
+"""Tests for oracle timing stats and the structured trace log."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.utils import tracing
+from sdnmpi_tpu.utils.tracing import OracleStats, STATS, set_trace_sink, trace_event
+
+
+@pytest.fixture(autouse=True)
+def _reset_sink():
+    yield
+    set_trace_sink(None)
+
+
+class TestOracleStats:
+    def test_timed_records_and_summarizes(self):
+        stats = OracleStats()
+        for _ in range(5):
+            with stats.timed("op_a", n=3):
+                pass
+        s = stats.summary()
+        assert s["op_a"]["count"] == 5
+        assert s["op_a"]["p50_ms"] >= 0.0
+        assert s["op_a"]["max_ms"] >= s["op_a"]["p50_ms"]
+
+    def test_bounded_samples(self):
+        stats = OracleStats(maxlen=8)
+        for _ in range(100):
+            with stats.timed("op"):
+                pass
+        assert stats.summary()["op"]["count"] == 8
+
+
+class TestTraceSink:
+    def test_jsonl_file_sink(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        set_trace_sink(path)
+        trace_event("test", value=42)
+        with OracleStats().timed("noop"):
+            pass
+        lines = [json.loads(line) for line in path.read_text().splitlines()]
+        assert lines[0]["kind"] == "test" and lines[0]["value"] == 42
+        assert lines[1]["kind"] == "oracle" and lines[1]["op"] == "noop"
+
+    def test_callable_sink_and_disable(self):
+        records = []
+        set_trace_sink(records.append)
+        trace_event("x", a=1)
+        assert records and records[0]["kind"] == "x"
+        set_trace_sink(None)
+        trace_event("y")
+        assert len(records) == 1  # disabled: nothing new
+
+
+def test_oracle_invocations_recorded():
+    """Running a batch through RouteOracle populates the global STATS."""
+    from sdnmpi_tpu.oracle.engine import RouteOracle
+    from sdnmpi_tpu.topogen import fattree
+
+    db = fattree(4).to_topology_db(backend="jax")
+    oracle = RouteOracle()
+    macs = sorted(db.hosts)
+    marker = -1.0  # float: keeps the global deque summarizable
+    STATS.samples["routes_batch"].append(marker)
+    oracle.routes_batch(db, [(macs[0], macs[1])])
+    # the bounded global deque gained a real sample after our marker
+    assert STATS.samples["routes_batch"][-1] != marker
+    STATS.samples["routes_batch"].remove(marker)
+    assert len(STATS.samples["oracle_refresh"]) >= 1
