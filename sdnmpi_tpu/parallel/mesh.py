@@ -139,6 +139,107 @@ def route_flows_sharded(
     return nodes, load, maxc[0, 0]
 
 
+def route_adaptive_sharded(
+    adj: jax.Array,
+    util: jax.Array,  # [V, V] f32 measured utilization (replicated)
+    src: jax.Array,
+    dst: jax.Array,
+    weight: jax.Array,
+    n_valid,
+    mesh: Mesh,
+    levels: int,
+    max_len: int = 8,
+    rounds: int = 2,
+    n_candidates: int = 4,
+    bias: float = 1.0,
+    max_degree: int = 32,
+    dist: jax.Array | None = None,  # cached apsp_distances(adj), else computed
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """UGAL adaptive routing with the flow batch sharded over ALL mesh
+    devices (the "flow" x "v" axes flattened — the [V, V] state is small
+    and replicated; flows are the scale axis).
+
+    The pipeline is staged so the balancing is *globally* consistent
+    with the single-device ``route_adaptive``: each shard makes UGAL
+    decisions and builds traffic for its own flows, the per-shard
+    traffic matrices are ``psum``-ed (one [V, V] all-reduce over ICI),
+    and every shard then runs the SAME balance_rounds on the full
+    batch's traffic — so split weights, the load matrix, and the
+    congestion figure all reflect the whole collective, exactly as if
+    routed on one device. Only the per-flow hash streams are
+    shard-local (flows at the same local index share noise; with
+    distinct endpoints the sampled paths still differ).
+
+    Same return contract as ``route_adaptive``: (inter, nodes1, nodes2,
+    load), with nodes/inter sharded over flows and load replicated.
+    """
+    from sdnmpi_tpu.oracle.adaptive import (
+        congestion_cost,
+        dag_weighted_costs,
+        ugal_choose,
+    )
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import balance_rounds, sample_paths_dense
+
+    u = src.shape[0]
+    n_shards = mesh.shape["flow"] * mesh.shape["v"]
+    if u % n_shards:
+        raise ValueError(f"flow count {u} must divide by {n_shards} shards")
+    have_dist = dist is not None
+    dist_arg = dist if have_dist else jnp.zeros_like(adj)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, None),
+            P(None, None),
+            P(None, None),
+            P(("flow", "v")),
+            P(("flow", "v")),
+            P(("flow", "v")),
+            P(),
+        ),
+        out_specs=(
+            P(("flow", "v")),
+            P(("flow", "v")),
+            P(("flow", "v")),
+            P(None, None),
+        ),
+        check_vma=False,  # psum-derived outputs are replicated
+    )
+    def inner(a, d_in, cost_util, s, t, w, nv):
+        v = a.shape[0]
+        d = d_in if have_dist else apsp_distances(a)
+        cost = congestion_cost(a, cost_util)
+        dmin = dag_weighted_costs(a, d, cost, levels=levels, max_degree=max_degree)
+        inter = ugal_choose(dmin, s, t, nv, n_candidates=n_candidates, bias=bias)
+
+        detour = inter >= 0
+        mid = jnp.where(detour, inter, t)
+        s2 = jnp.where(detour, mid, -1)
+        d2 = jnp.where(detour, t, -1)
+        w_live = jnp.where((s >= 0) & (t >= 0), w, 0.0)
+        traffic = jnp.zeros((v, v), jnp.float32)
+        traffic = traffic.at[jnp.maximum(mid, 0), jnp.maximum(s, 0)].add(
+            jnp.where(s >= 0, w_live, 0.0)
+        )
+        traffic = traffic.at[jnp.maximum(d2, 0), jnp.maximum(s2, 0)].add(
+            jnp.where(detour, w_live, 0.0)
+        )
+        # the one collective: every shard balances the FULL batch
+        traffic = lax.psum(traffic, ("flow", "v"))
+
+        weights, load, _ = balance_rounds(
+            a, d, cost_util, traffic, levels=levels, rounds=rounds
+        )
+        n1, _ = sample_paths_dense(weights, d, s, mid, max_len)
+        n2, _ = sample_paths_dense(weights, d, s2, d2, max_len, salt=0x5BD1E995)
+        return inter, n1, n2, load
+
+    return inner(adj, dist_arg, util, src, dst, weight, jnp.int32(n_valid))
+
+
 def multichip_route_step(
     adj: jax.Array,
     base_cost: jax.Array,
