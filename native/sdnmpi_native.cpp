@@ -116,6 +116,15 @@ void materialize_fdbs(const int32_t* paths, const int32_t* port,
     if (n == 0) continue;
     const int32_t last = row[n - 1];
     if (dstsw[i] >= 0 && last != dstsw[i]) continue;
+    // last line of defense before flow install: every consecutive hop
+    // must be a real link (port >= 0), or a malformed/discontinuous
+    // stitched path that happens to end at dst would install a garbage
+    // port (mirrors decode_slots' adjacency guard)
+    bool contiguous = true;
+    for (int64_t h = 0; h + 1 < n; ++h) {
+      if (port[(int64_t)row[h] * v + row[h + 1]] < 0) { contiguous = false; break; }
+    }
+    if (!contiguous) continue;
     for (int64_t h = 0; h + 1 < n; ++h) {
       od[h] = dpids[row[h]];
       op[h] = port[(int64_t)row[h] * v + row[h + 1]];

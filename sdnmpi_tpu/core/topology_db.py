@@ -275,7 +275,7 @@ class TopologyDB:
         alpha: float = 1.0,
         link_capacity: float = 10e9,
         ecmp_ways: int = 4,
-    ) -> tuple[list[list[tuple[int, int]]], int]:
+    ) -> tuple[list[list[tuple[int, int]]], int, float]:
         """UGAL adaptive min/non-min batched routing (oracle/adaptive.py):
         flows may detour through a Valiant intermediate when measured
         congestion makes their hop-minimal routes expensive — the right

@@ -16,6 +16,7 @@ runtime-native part of the rebuild).
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import pathlib
 import subprocess
@@ -37,16 +38,33 @@ def _load() -> Optional[ctypes.CDLL]:
     _tried = True
     if os.environ.get("SDNMPI_NO_NATIVE"):
         return None
-    if (_NATIVE_DIR / "Makefile").exists():
-        try:  # always invoke make: a fresh .so is a no-op, a stale one
-            # (edited .cpp) rebuilds; stay silent on any failure
+    # implicit build only when the .so is absent or older than its sources
+    # — a routine first call must not stall the controller behind make on
+    # a broken toolchain; SDNMPI_NATIVE_REBUILD=1 forces a rebuild
+    def _stale() -> bool:
+        if not _LIB_PATH.exists():
+            return True
+        so_mtime = _LIB_PATH.stat().st_mtime
+        return any(
+            p.exists() and p.stat().st_mtime > so_mtime
+            for p in (_NATIVE_DIR / "sdnmpi_native.cpp", _NATIVE_DIR / "Makefile")
+        )
+
+    want_build = _stale() or os.environ.get("SDNMPI_NATIVE_REBUILD")
+    if want_build and (_NATIVE_DIR / "Makefile").exists():
+        try:
             subprocess.run(
                 ["make", "-C", str(_NATIVE_DIR)],
                 capture_output=True, timeout=120, check=True,
             )
-        except Exception:
-            pass  # fall through: a previously-built .so may still load
+        except Exception as exc:
+            logging.getLogger("native").debug(
+                "native build failed (%s); using numpy fallbacks", exc
+            )
     if not _LIB_PATH.exists():
+        logging.getLogger("native").debug(
+            "libsdnmpi_native.so not found; using numpy fallbacks"
+        )
         return None
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
@@ -182,6 +200,10 @@ def materialize_fdbs(
             if len(row) == 0:
                 continue
             if dst_switch[i] >= 0 and row[-1] != dst_switch[i]:
+                continue
+            # adjacency guard: a discontinuous path must not install
+            # (port -1 means no such link) — same check as the C++ kernel
+            if len(row) > 1 and (port[row[:-1], row[1:]] < 0).any():
                 continue
             for h in range(len(row) - 1):
                 out_dpid[i, h] = dpids[row[h]]

@@ -161,6 +161,7 @@ def ugal_choose(
     n_candidates: int = 4,
     bias: float = 1.0,
     salt: int = 0,
+    fid_base: jax.Array | int = 0,  # global index of flow 0 (sharded callers)
 ) -> jax.Array:
     """Per-flow UGAL-G decision: returns [F] int32 intermediate node, or
     ``-1`` to route minimally.
@@ -175,7 +176,7 @@ def ugal_choose(
     """
     v = dw.shape[0]
     f = src.shape[0]
-    fid = jnp.arange(f, dtype=jnp.uint32)
+    fid = jnp.arange(f, dtype=jnp.uint32) + jnp.asarray(fid_base).astype(jnp.uint32)
     ks = jnp.arange(n_candidates, dtype=jnp.uint32)
     r = _hash_u32(
         (fid * jnp.uint32(2654435761))[:, None]

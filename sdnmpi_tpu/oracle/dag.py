@@ -227,6 +227,7 @@ def sample_paths_dense(
     dst: jax.Array,  # [F] int32
     max_len: int,
     salt: int = 0,
+    fid_base: jax.Array | int = 0,  # global index of flow 0 (sharded callers)
 ) -> tuple[jax.Array, jax.Array]:
     """MXU formulation of ``sample_paths`` — same contract, no gathers.
 
@@ -268,7 +269,10 @@ def sample_paths_dense(
     d2t = (oh_dst @ dist_bf).astype(jnp.float32)  # [F, V] dist[j, dst_f]
 
     iota = jnp.arange(v, dtype=jnp.int32)
-    fid = jnp.arange(f, dtype=jnp.uint32)
+    # fid_base shifts flow ids to their *global* batch index so a sharded
+    # caller (parallel/mesh.py) draws the same noise stream per flow as
+    # the single-device path — bit-identical sampled paths
+    fid = jnp.arange(f, dtype=jnp.uint32) + jnp.asarray(fid_base).astype(jnp.uint32)
     alive0 = (src >= 0) & (dst >= 0)
     dsrc = jnp.take_along_axis(d2t, jnp.maximum(src, 0)[:, None], axis=1)[:, 0]
     alive0 &= dsrc < unreach

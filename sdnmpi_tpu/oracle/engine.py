@@ -465,7 +465,7 @@ class RouteOracle:
         alpha: float = 1.0,
         link_capacity: float = 10e9,
         ecmp_ways: int = 4,
-    ) -> tuple[list[list[tuple[int, int]]], int]:
+    ) -> tuple[list[list[tuple[int, int]]], int, float]:
         """UGAL adaptive min/non-min batch routing (oracle/adaptive.py).
 
         Like :meth:`routes_batch_balanced` but each aggregated flow may
@@ -477,10 +477,16 @@ class RouteOracle:
         intra-group ECMP spreading is preserved alongside the UGAL
         choice. Returns ``(fdbs, n_detoured_pairs, max_congestion)`` —
         the number of input pairs whose installed route takes a Valiant
-        detour, and the max fractional link load of the balanced
-        assignment.
+        detour, and the max *discrete* link load of the routes actually
+        installed (each installed pair counts 1 on every link of its
+        stitched path — the same quantity a host recomputation from the
+        returned fdbs yields, not the balancer's fractional bound).
         """
-        from sdnmpi_tpu.oracle.adaptive import route_adaptive, stitch_paths
+        from sdnmpi_tpu.oracle.adaptive import (
+            link_loads,
+            route_adaptive,
+            stitch_paths,
+        )
 
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
@@ -498,7 +504,7 @@ class RouteOracle:
 
         base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
 
-        inter, n1, n2, load = route_adaptive(
+        inter, n1, n2, _ = route_adaptive(
             t.adj,
             jnp.asarray(base.astype(np.float32)),
             jnp.asarray(src_idx),
@@ -517,8 +523,14 @@ class RouteOracle:
         inter_h = np.asarray(inter)
         installed = self._materialize_fdbs(t, groups, group_subs, paths, results)
         n_detours = sum(1 for _, g in installed if inter_h[g] >= 0)
-        adj_mask = np.asarray(t.adj) > 0
-        maxc = float(np.asarray(load).max(initial=0.0, where=adj_mask))
+        # installed (discrete) congestion: each installed pair adds 1 to
+        # every link of its sub-flow's stitched path — native scatter-add
+        # over the sub-flow paths weighted by installed-member counts
+        counts = np.zeros(paths.shape[0], np.float32)
+        for _, g in installed:
+            counts[g] += 1.0
+        discrete = link_loads(paths, counts, t.v)
+        maxc = float(discrete.max(initial=0.0))
         return results, n_detours, maxc
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------

@@ -166,9 +166,13 @@ def route_adaptive_sharded(
     and every shard then runs the SAME balance_rounds on the full
     batch's traffic — so split weights, the load matrix, and the
     congestion figure all reflect the whole collective, exactly as if
-    routed on one device. Only the per-flow hash streams are
-    shard-local (flows at the same local index share noise; with
-    distinct endpoints the sampled paths still differ).
+    routed on one device. Per-flow hash streams are seeded with each
+    flow's *global* batch index (shard base + local offset), so UGAL
+    choices and sampled paths match the single-device ``route_adaptive``
+    on the same batch — bit-identical when the weights sum exactly in
+    f32 (e.g. integer weights; fractional weights can differ by an ulp
+    between the psum and the single-device scatter-add, which may flip
+    a tied Gumbel argmax downstream).
 
     Same return contract as ``route_adaptive``: (inter, nodes1, nodes2,
     load), with nodes/inter sharded over flows and load replicated.
@@ -210,10 +214,17 @@ def route_adaptive_sharded(
     )
     def inner(a, d_in, cost_util, s, t, w, nv):
         v = a.shape[0]
+        # global index of this shard's first flow: hash streams must be
+        # keyed by global flow id for parity with route_adaptive
+        shard_idx = lax.axis_index("flow") * mesh.shape["v"] + lax.axis_index("v")
+        fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
         d = d_in if have_dist else apsp_distances(a)
         cost = congestion_cost(a, cost_util)
         dmin = dag_weighted_costs(a, d, cost, levels=levels, max_degree=max_degree)
-        inter = ugal_choose(dmin, s, t, nv, n_candidates=n_candidates, bias=bias)
+        inter = ugal_choose(
+            dmin, s, t, nv, n_candidates=n_candidates, bias=bias,
+            fid_base=fid_base,
+        )
 
         detour = inter >= 0
         mid = jnp.where(detour, inter, t)
@@ -233,8 +244,10 @@ def route_adaptive_sharded(
         weights, load, _ = balance_rounds(
             a, d, cost_util, traffic, levels=levels, rounds=rounds
         )
-        n1, _ = sample_paths_dense(weights, d, s, mid, max_len)
-        n2, _ = sample_paths_dense(weights, d, s2, d2, max_len, salt=0x5BD1E995)
+        n1, _ = sample_paths_dense(weights, d, s, mid, max_len, fid_base=fid_base)
+        n2, _ = sample_paths_dense(
+            weights, d, s2, d2, max_len, salt=0x5BD1E995, fid_base=fid_base
+        )
         return inter, n1, n2, load
 
     return inner(adj, dist_arg, util, src, dst, weight, jnp.int32(n_valid))

@@ -292,6 +292,24 @@ class TestEngineAdaptive:
         for fdb in fdbs:
             assert fdb  # every pair still routed
 
+    def test_adaptive_reports_installed_discrete_congestion(self):
+        """max_congestion is the discrete load of the fdbs actually
+        returned — a host recomputation from the reply must match it
+        exactly (not the balancer's fractional bound)."""
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+
+        spec = dragonfly(4, 4, hosts_per_router=1)
+        db = spec.to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        macs = sorted(db.hosts)
+        pairs = [(a, b) for a in macs for b in macs if a != b]
+        fdbs, _, maxc = oracle.routes_batch_adaptive(db, pairs, ecmp_ways=2)
+        load: dict[tuple[int, int], float] = {}
+        for fdb in fdbs:
+            for (d1, _), (d2, _) in zip(fdb, fdb[1:]):
+                load[(d1, d2)] = load.get((d1, d2), 0.0) + 1.0
+        assert maxc == max(load.values(), default=0.0)
+
     def test_ecmp_subflows_diversify_group_paths(self):
         """Pairs aggregating to one (edge, edge) transit must not all
         ride one sampled path — the sub-flow split has to spread them

@@ -55,3 +55,41 @@ def test_sharded_adaptive_valid_paths_and_global_load():
     load = np.asarray(load)
     discrete = link_loads(paths, w, v)
     np.testing.assert_allclose(load.sum(), discrete.sum(), rtol=1e-4)
+
+
+def test_sharded_adaptive_matches_single_device():
+    """Hash streams are keyed by *global* flow index, so the sharded
+    pipeline reproduces route_adaptive bit-for-bit on the same batch."""
+    from sdnmpi_tpu.oracle.adaptive import route_adaptive
+
+    mesh = make_mesh(8)
+    spec = dragonfly(4, 4)
+    db = spec.to_topology_db(backend="jax")
+    t = tensorize(db)
+    v = t.adj.shape[0]
+    adj = np.asarray(t.adj)
+
+    rng = np.random.default_rng(1)
+    n = 64
+    src = rng.integers(0, t.n_real, n).astype(np.int32)
+    grp = src // 4
+    dst = (((grp + 1) % 4) * 4 + rng.integers(0, 4, n)).astype(np.int32)
+    w = np.ones(n, np.float32)
+    groups = np.arange(v) // 4
+    util = np.zeros((v, v), np.float32)
+    hot = (groups[None, :] == (groups[:, None] + 1) % 4) & (adj > 0)
+    util[hot] = 50.0
+
+    kwargs = dict(levels=4, max_len=8, n_candidates=8, max_degree=t.max_degree)
+    inter_s, n1_s, n2_s, load_s = route_adaptive_sharded(
+        t.adj, jnp.asarray(util), jnp.asarray(src), jnp.asarray(dst),
+        jnp.asarray(w), t.n_real, mesh, **kwargs,
+    )
+    inter_1, n1_1, n2_1, load_1 = route_adaptive(
+        t.adj, jnp.asarray(util), jnp.asarray(src), jnp.asarray(dst),
+        jnp.asarray(w), jnp.int32(t.n_real), rounds=2, **kwargs,
+    )
+    np.testing.assert_array_equal(np.asarray(inter_s), np.asarray(inter_1))
+    np.testing.assert_array_equal(np.asarray(n1_s), np.asarray(n1_1))
+    np.testing.assert_array_equal(np.asarray(n2_s), np.asarray(n2_1))
+    np.testing.assert_allclose(np.asarray(load_s), np.asarray(load_1), rtol=1e-5)
