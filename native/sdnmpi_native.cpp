@@ -135,6 +135,96 @@ void materialize_fdbs(const int32_t* paths, const int32_t* port,
   }
 }
 
+// Fused per-pair grouping: endpoint -> edge-switch LUT gathers, the
+// dense (src_edge, dst_edge) key, and the per-key histogram in ONE
+// O(F) pass (the numpy equivalent runs five 16.7M-element passes).
+// key_out[i] = -1 marks a pair with an unresolved endpoint.
+void group_pairs(const int32_t* src_idx, const int32_t* dst_idx,
+                 const int32_t* edge, int64_t f, int64_t v,
+                 int64_t* counts_all /* [v*v], caller zeroes */,
+                 int64_t* key_out /* [F] */) {
+  for (int64_t i = 0; i < f; ++i) {
+    const int32_t a = edge[src_idx[i]], b = edge[dst_idx[i]];
+    if (a < 0 || b < 0) { key_out[i] = -1; continue; }
+    const int64_t k = (int64_t)a * v + b;
+    key_out[i] = k;
+    ++counts_all[k];
+  }
+}
+
+// group_pairs' companion: sub-flow deal straight from the dense keys
+// (lookup maps key -> group id), fusing what would otherwise be an inv
+// gather plus deal_subflows into one pass.
+void deal_subflows_keyed(const int64_t* key, const int32_t* src_idx,
+                         const int32_t* dst_idx, const int64_t* lookup,
+                         const int32_t* nsub, const int64_t* sub_base,
+                         int64_t f, int32_t* pair_sub) {
+  for (int64_t i = 0; i < f; ++i) {
+    if (key[i] < 0) { pair_sub[i] = -1; continue; }
+    const int64_t g = lookup[key[i]];
+    const uint32_t h = (uint32_t)src_idx[i] * 2654435761u
+                     ^ (uint32_t)dst_idx[i] * 0x85EBCA77u;
+    pair_sub[i] = (int32_t)(sub_base[g] + h % (uint32_t)nsub[g]);
+  }
+}
+
+// Deal collective pairs onto ECMP sub-flows: pair i of group inv[i]
+// lands on sub-flow sub_base[g] + hash(src_idx[i], dst_idx[i]) % nsub[g].
+// The hash spreads a group's members across its sub-flows (and hence
+// across sampled equal-cost paths) deterministically with no sort —
+// O(F) for the 16.7M-pair alltoall where argsort costs seconds.
+void deal_subflows(const int32_t* inv, const int32_t* src_idx,
+                   const int32_t* dst_idx, const int32_t* nsub,
+                   const int64_t* sub_base, int64_t f, int32_t* pair_sub) {
+  for (int64_t i = 0; i < f; ++i) {
+    const int32_t g = inv[i];
+    const uint32_t h = (uint32_t)src_idx[i] * 2654435761u
+                     ^ (uint32_t)dst_idx[i] * 0x85EBCA77u;
+    pair_sub[i] = (int32_t)(sub_base[g] + h % (uint32_t)nsub[g]);
+  }
+}
+
+// Counting-sort collective pairs by sub-flow, fused with the member-key
+// production the block install needs: one O(F) pass computes per-sub
+// counts, a prefix sum yields bounds, and a second O(F) pass scatters
+// each pair's (src MAC key, vMAC key, rewrite key, final port) into its
+// sub-flow's contiguous slice. Keys come from per-ENDPOINT lookup
+// tables (N entries, cache-resident), so there is no random access into
+// F-sized arrays anywhere — the comparison-sort + 4 fancy-gather
+// equivalent in numpy is ~10x slower at alltoall scale.
+//
+// vmac_src_lut/vmac_dst_lut hold each endpoint's contribution to the
+// virtual MAC (vmac = vmac_base | src_part | dst_part — see
+// protocol/vmac.py byte layout).
+void scatter_members(const int32_t* pair_sub, const int32_t* src_idx,
+                     const int32_t* dst_idx, const int64_t* src_key_lut,
+                     const int64_t* vmac_src_lut, const int64_t* vmac_dst_lut,
+                     const int64_t* rewrite_lut, const int32_t* fport_lut,
+                     int64_t vmac_base, int64_t f, int64_t s,
+                     int64_t* bounds,  // [s + 1] out
+                     int64_t* m_src, int64_t* m_vmac, int64_t* m_rewrite,
+                     int32_t* m_fport) {
+  for (int64_t j = 0; j <= s; ++j) bounds[j] = 0;
+  for (int64_t i = 0; i < f; ++i) {
+    if (pair_sub[i] >= 0) ++bounds[pair_sub[i] + 1];
+  }
+  for (int64_t j = 0; j < s; ++j) bounds[j + 1] += bounds[j];
+  // cursor reuses a scratch copy of bounds
+  int64_t* cursor = new int64_t[s];
+  for (int64_t j = 0; j < s; ++j) cursor[j] = bounds[j];
+  for (int64_t i = 0; i < f; ++i) {
+    const int32_t sub = pair_sub[i];
+    if (sub < 0) continue;
+    const int64_t c = cursor[sub]++;
+    const int32_t si = src_idx[i], di = dst_idx[i];
+    m_src[c] = src_key_lut[si];
+    m_vmac[c] = vmac_base | vmac_src_lut[si] | vmac_dst_lut[di];
+    m_rewrite[c] = rewrite_lut[di];
+    m_fport[c] = fport_lut[di];
+  }
+  delete[] cursor;
+}
+
 // Announcement sideband codec (UDP:61000 payload).
 // Layout: little-endian int32 type {0=LAUNCH, 1=EXIT} + int32 rank —
 // byte-identical to protocol/announcement.py and the reference's

@@ -59,6 +59,20 @@ class RPCInterface:
         bus.subscribe(ev.EventLinkAdd, lambda e: self._broadcast("add_link", _to_dict(e.link)))
         bus.subscribe(ev.EventLinkDelete, lambda e: self._broadcast("delete_link", _to_dict(e.link)))
         bus.subscribe(ev.EventHostAdd, lambda e: self._broadcast("add_host", _to_dict(e.host)))
+        # block-installed collectives mirror as summaries, never per-pair
+        # rows (an alltoall would be 16.7M update_fdb calls); extension
+        # methods beyond the reference protocol
+        bus.subscribe(
+            ev.EventCollectiveInstalled,
+            lambda e: self._broadcast(
+                "install_collective",
+                e.cookie, e.coll_type, e.n_pairs, e.n_flows, e.max_congestion,
+            ),
+        )
+        bus.subscribe(
+            ev.EventCollectiveRemoved,
+            lambda e: self._broadcast("remove_collective", e.cookie),
+        )
 
     # -- client lifecycle -------------------------------------------------
 
@@ -71,6 +85,8 @@ class RPCInterface:
         self._call(client, "init_rankdb", rankdb.to_dict())
         topology = self.bus.request(ev.CurrentTopologyRequest()).topology
         self._call(client, "init_topologydb", topology.to_dict())
+        collectives = self.bus.request(ev.CurrentCollectivesRequest()).collectives
+        self._call(client, "init_collectives", collectives.to_dict())
 
     def attach_client(self, client: RPCClient) -> None:
         self.clients.append(client)

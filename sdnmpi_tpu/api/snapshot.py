@@ -14,6 +14,8 @@ from __future__ import annotations
 import json
 import pathlib
 
+import numpy as np
+
 from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
 
 SNAPSHOT_VERSION = 1
@@ -28,6 +30,20 @@ def snapshot_controller(controller) -> dict:
         "link_util": [
             [dpid, port, bps]
             for (dpid, port), bps in controller.topology_manager.link_util.items()
+        ],
+        # block-installed collectives by identity, not by flow: restore
+        # re-routes them against the live topology (pair arrays are
+        # regenerated from the stored index arrays)
+        "collectives": [
+            {
+                "coll_type": i.coll_type,
+                "root": i.root,
+                "ranks": list(i.ranks),
+                "policy": i.policy,
+                "src_idx": np.asarray(i.src_idx).tolist(),
+                "dst_idx": np.asarray(i.dst_idx).tolist(),
+            }
+            for i in controller.router.collectives
         ],
     }
 
@@ -78,6 +94,25 @@ def restore_controller(controller, snapshot: dict) -> None:
         }
     )
     controller.router.reinstall_pairs([(s, d) for s, d in pairs])
+
+    # Block-installed collectives re-route wholesale against the live
+    # topology and process registry (same discipline as reinstall_pairs:
+    # the snapshot's identity is trusted, its paths are not).
+    from sdnmpi_tpu.control.events import CurrentProcessAllocationRequest
+
+    rankdb = controller.bus.request(CurrentProcessAllocationRequest()).processes
+    for coll in snapshot.get("collectives", []):
+        pairs_arr = np.stack(
+            [
+                np.asarray(coll["src_idx"], dtype=np.int64),
+                np.asarray(coll["dst_idx"], dtype=np.int64),
+            ],
+            axis=1,
+        )
+        controller.router._install_collective_blocks(
+            coll["coll_type"], list(coll["ranks"]), coll["root"],
+            pairs_arr, rankdb, policy=coll.get("policy"),
+        )
 
 
 def _port(d: dict) -> Port:

@@ -60,6 +60,18 @@ class Config:
     proactive_collectives: bool = True
     #: device chunk size for the balanced-routing scan
     ecmp_chunk: int = 4096
+    #: sub-flow count at or above which balanced batches route through
+    #: the MXU-native DAG balancer + fused sampler (oracle/dag.py, the
+    #: flagship fast path) instead of the greedy scanner
+    dag_flow_threshold: int = 512
+    #: congestion-reweighting rounds of the DAG balancer
+    balance_rounds: int = 2
+    #: rank-pair count at or above which a proactive collective install
+    #: uses the array-native block path (int MAC keys, shared
+    #: FlowPathBlocks, one event per collective) instead of the
+    #: reference-shaped per-pair path (string MACs, per-pair dedup,
+    #: per-hop FDB events)
+    block_install_threshold: int = 4096
     #: routing policy for proactive collective batches: "balanced"
     #: (load-aware ECMP — right for fat-trees) or "adaptive" (UGAL
     #: min/non-min — right for low-diameter topologies like dragonfly)

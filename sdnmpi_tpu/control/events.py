@@ -149,6 +149,27 @@ class FindRoutesBatchReply(Reply):
 
 
 @dataclasses.dataclass
+class FindCollectiveRoutesRequest(Request):
+    """Array-native whole-collective routing: ``macs`` lists the N unique
+    endpoints once, ``src_idx``/``dst_idx`` are [F] int indices into it.
+    Replaces F per-pair queries with one request whose reply is a
+    ``CollectiveRoutes`` (oracle/batch.py) — no per-pair Python objects
+    anywhere on the path. This is the scaled form of the seam the
+    reference serves one pair at a time (sdnmpi/topology.py:138-142)."""
+
+    dst = "TopologyManager"
+    macs: list
+    src_idx: Any  # [F] int array
+    dst_idx: Any  # [F] int array
+    policy: str = "balanced"
+
+
+@dataclasses.dataclass
+class FindCollectiveRoutesReply(Reply):
+    routes: Any  # oracle.batch.CollectiveRoutes
+
+
+@dataclasses.dataclass
 class BroadcastRequest(Request):
     dst = "TopologyManager"
     pkt: Packet
@@ -218,6 +239,26 @@ class EventFDBRemove(Event):
 
 
 @dataclasses.dataclass
+class EventCollectiveInstalled(Event):
+    """A whole collective's flows were block-installed proactively (no
+    reference equivalent — the reference decodes the collective type but
+    only logs it, sdnmpi/router.py:182). ``cookie`` identifies the
+    install for teardown; counts summarize what per-pair FDB events
+    would have reported one at a time."""
+
+    cookie: int
+    coll_type: int
+    n_pairs: int
+    n_flows: int  # switch-level flow entries across all blocks
+    max_congestion: float
+
+
+@dataclasses.dataclass
+class EventCollectiveRemoved(Event):
+    cookie: int
+
+
+@dataclasses.dataclass
 class CurrentFDBRequest(Request):
     dst = "Router"
 
@@ -225,6 +266,16 @@ class CurrentFDBRequest(Request):
 @dataclasses.dataclass
 class CurrentFDBReply(Reply):
     fdb: Any
+
+
+@dataclasses.dataclass
+class CurrentCollectivesRequest(Request):
+    dst = "Router"
+
+
+@dataclasses.dataclass
+class CurrentCollectivesReply(Reply):
+    collectives: Any  # core.collective_table.CollectiveTable
 
 
 # -- monitor --------------------------------------------------------------

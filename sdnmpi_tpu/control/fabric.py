@@ -54,12 +54,74 @@ class _FlowEntry:
     seq: int  # insertion order tie-break
 
 
+class _BlockSetEntry:
+    """One switch's share of a FlowBlockSet: the (sub-flow, hop) rows
+    whose ``hop_dpid`` is this switch.
+
+    Row arrays are views of the install-time partition (no copies). The
+    (src, dst) -> (member, hop row) map is built lazily on first
+    lookup, so only switches that actually field a data-plane packet
+    pay for indexing; a later row overwrites an earlier one for the
+    same member, which shortcuts revisit loops (see FlowBlockSet).
+    """
+
+    __slots__ = ("priority", "seq", "block", "sub_rows", "hop_rows", "_index")
+
+    def __init__(self, priority: int, seq: int, block, sub_rows, hop_rows):
+        self.priority = priority
+        self.seq = seq
+        self.block = block
+        self.sub_rows = sub_rows  # [R] int64 sub-flow ids at this switch
+        self.hop_rows = hop_rows  # [R] int64 hop index of each row
+        self._index = None
+
+    def member(self, src_key: int, dst_key: int):
+        if self._index is None:
+            import numpy as np
+
+            b = self.block
+            bounds = np.asarray(b.bounds)
+            starts = bounds[self.sub_rows]
+            reps = bounds[self.sub_rows + 1] - starts
+            total = int(reps.sum())
+            # member ids: concatenated aranges of each row's slice
+            # (vectorized — a core switch's entry can cover millions of
+            # member flows, so no Python-level per-member loop)
+            m_ids = np.repeat(starts + reps - reps.cumsum(), reps) + np.arange(
+                total
+            )
+            last = self.hop_rows == np.asarray(b.hop_len)[self.sub_rows] - 1
+            ports = np.where(
+                last, -1, np.asarray(b.hop_port)[self.sub_rows, self.hop_rows]
+            )
+            m_ports = np.repeat(ports, reps)
+            src = np.asarray(b.src)[m_ids].tolist()
+            dst = np.asarray(b.dst)[m_ids].tolist()
+            self._index = dict(
+                zip(zip(src, dst), zip(m_ids.tolist(), m_ports.tolist()))
+            )
+        return self._index.get((src_key, dst_key))
+
+    def actions_for(self, hit) -> tuple[of.Action, ...]:
+        from sdnmpi_tpu.utils.mac import int_to_mac
+
+        member, port = hit
+        b = self.block
+        if port >= 0:  # transit hop
+            return (of.ActionOutput(port),)
+        out: tuple[of.Action, ...] = ()
+        if b.rewrite is not None:
+            out = (of.ActionSetDlDst(int_to_mac(int(b.rewrite[member]))),)
+        return out + (of.ActionOutput(int(b.final_port[member])),)
+
+
 class SimSwitch:
     def __init__(self, fabric: "Fabric", dpid: int) -> None:
         self.fabric = fabric
         self.dpid = dpid
         self.ports: dict[int, SimPort] = {}
         self.flow_table: list[_FlowEntry] = []
+        self.block_table: list[_BlockSetEntry] = []
         self.local_delivered: list[of.Packet] = []  # OFPP_LOCAL sink
         self._seq = 0
 
@@ -81,11 +143,42 @@ class SimSwitch:
         else:
             raise ValueError(f"unsupported flow_mod command {mod.command}")
 
-    def lookup(self, pkt: of.Packet, in_port: int) -> Optional[_FlowEntry]:
+    def add_block_entry(self, entry: _BlockSetEntry) -> None:
+        self.block_table.append(entry)
+
+    def remove_blocks(self, cookie: int) -> None:
+        self.block_table = [
+            e for e in self.block_table if e.block.cookie != cookie
+        ]
+
+    def lookup(self, pkt: of.Packet, in_port: int):
+        """Highest-priority match across the scalar flow table and the
+        block table (earlier install wins ties, like the scalar sort)."""
+        best = None
         for entry in self.flow_table:
             if entry.match.matches(pkt, in_port):
-                return entry
-        return None
+                best = entry
+                break  # table is priority-sorted
+        if self.block_table:
+            from sdnmpi_tpu.utils.mac import mac_to_int
+
+            try:
+                src_key = mac_to_int(pkt.eth_src)
+                dst_key = mac_to_int(pkt.eth_dst)
+            except ValueError:
+                return best
+            for b in self.block_table:
+                if best is not None and (-best.priority, best.seq) <= (
+                    -b.priority,
+                    b.seq,
+                ):
+                    continue
+                m = b.member(src_key, dst_key)
+                if m is not None:
+                    best = _FlowEntry(
+                        b.priority, of.Match(), b.actions_for(m), b.seq
+                    )
+        return best
 
     # -- data path --------------------------------------------------------
 
@@ -264,6 +357,46 @@ class Fabric:
             log.debug("flow_mod to unknown dpid %s dropped", dpid)
             return
         sw.flow_mod(mod)
+
+    def flow_block_set(self, block: of.FlowBlockSet) -> None:
+        """Install a whole collective's flows: partition the (sub-flow,
+        hop) rows by switch with array ops, then hand each switch ONE
+        entry referencing its row slice — O(#switches) Python for
+        S x L x M worth of flow entries. Unknown dpids are skipped like
+        flow_mod's dead-datapath case."""
+        import numpy as np
+
+        hop_len = np.asarray(block.hop_len)
+        s_count, l_max = np.asarray(block.hop_dpid).shape
+        valid = np.arange(l_max)[None, :] < hop_len[:, None]
+        sub_rows, hop_rows = np.nonzero(valid)
+        dpids = np.asarray(block.hop_dpid)[sub_rows, hop_rows]
+        if len(dpids) == 0:
+            return
+        order = np.argsort(dpids, kind="stable")
+        dpids = dpids[order]
+        sub_rows = sub_rows[order]
+        hop_rows = hop_rows[order]
+        cuts = np.flatnonzero(np.diff(dpids)) + 1
+        starts = np.concatenate([[0], cuts])
+        ends = np.concatenate([cuts, [len(dpids)]])
+        for lo, hi in zip(starts, ends):
+            sw = self.switches.get(int(dpids[lo]))
+            if sw is None:
+                log.debug("block rows for unknown dpid skipped")
+                continue
+            sw._seq += 1
+            sw.add_block_entry(
+                _BlockSetEntry(
+                    block.priority, sw._seq, block,
+                    sub_rows[lo:hi], hop_rows[lo:hi],
+                )
+            )
+
+    def flow_blocks_delete(self, cookie: int) -> None:
+        """Tear down every block entry of a collective install."""
+        for sw in self.switches.values():
+            sw.remove_blocks(cookie)
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
         self.switches[dpid].apply_actions(out.actions, out.data, out.in_port, hops=0)

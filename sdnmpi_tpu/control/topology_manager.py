@@ -63,6 +63,7 @@ class TopologyManager:
         bus.provide(ev.FindRouteRequest, self._find_route)
         bus.provide(ev.FindAllRoutesRequest, self._find_all_routes)
         bus.provide(ev.FindRoutesBatchRequest, self._find_routes_batch)
+        bus.provide(ev.FindCollectiveRoutesRequest, self._find_routes_collective)
         bus.provide(ev.BroadcastRequest, self._broadcast_request)
 
     # -- bootstrap flows (reference: sdnmpi/topology.py:94-108) -----------
@@ -122,6 +123,8 @@ class TopologyManager:
                 chunk=self.config.ecmp_chunk,
                 link_capacity=self.config.link_capacity_bps,
                 ecmp_ways=self.config.ecmp_ways,
+                rounds=self.config.balance_rounds,
+                dag_threshold=self.config.dag_flow_threshold,
             )
             return ev.FindRoutesBatchReply(fdbs, max_congestion)
         if req.policy == "adaptive":
@@ -145,6 +148,25 @@ class TopologyManager:
                 req.policy,
             )
         return ev.FindRoutesBatchReply(self.topologydb.find_routes_batch(req.pairs))
+
+    def _find_routes_collective(
+        self, req: ev.FindCollectiveRoutesRequest
+    ) -> ev.FindCollectiveRoutesReply:
+        cfg = self.config
+        kwargs = dict(
+            link_util=self.link_util,
+            alpha=cfg.congestion_alpha,
+            link_capacity=cfg.link_capacity_bps,
+            ecmp_ways=cfg.ecmp_ways,
+            rounds=cfg.balance_rounds,
+        )
+        if req.policy == "adaptive":
+            kwargs["ugal_candidates"] = cfg.ugal_candidates
+            kwargs["ugal_bias"] = cfg.ugal_bias
+        routes = self.topologydb.find_routes_collective(
+            req.macs, req.src_idx, req.dst_idx, policy=req.policy, **kwargs
+        )
+        return ev.FindCollectiveRoutesReply(routes)
 
     def _broadcast_request(self, req: ev.BroadcastRequest) -> ev.BroadcastReply:
         self._do_broadcast(req.pkt, req.src_dpid, req.src_in_port)

@@ -247,17 +247,23 @@ class TopologyDB:
         chunk: int = 4096,
         link_capacity: float = 10e9,
         ecmp_ways: int = 4,
+        rounds: int = 2,
+        dag_threshold: Optional[int] = None,
     ) -> tuple[list[list[tuple[int, int]]], float]:
         """Load-aware batched routing: the whole batch is spread across
-        equal-cost paths on device, seeded with measured link utilization
-        (oracle/congestion.py). Returns (fdbs, max_congestion).
+        equal-cost paths on device, seeded with measured link utilization.
+        Returns (fdbs, max_congestion). Batches with >= ``dag_threshold``
+        sub-flows use the MXU-native DAG balancer + fused sampler
+        (oracle/dag.py); smaller ones the exact greedy scanner
+        (oracle/congestion.py) — see RouteOracle.routes_batch_balanced.
 
         The pure-Python backend has no balancing; it degrades to the plain
         batch with a congestion figure computed from the chosen paths.
         """
         if self.backend == "jax":
             return self._jax_oracle().routes_batch_balanced(
-                self, pairs, link_util, alpha, chunk, link_capacity, ecmp_ways
+                self, pairs, link_util, alpha, chunk, link_capacity,
+                ecmp_ways, rounds, dag_threshold,
             )
         fdbs = [self.find_route(s, d) for s, d in pairs]
         load: dict[tuple[int, int], float] = {}
@@ -297,6 +303,69 @@ class TopologyDB:
                 ecmp_ways=ecmp_ways,
             )
         return [self.find_route(s, d) for s, d in pairs], 0, 0.0
+
+    def find_routes_collective(
+        self,
+        macs: list,
+        src_idx,
+        dst_idx,
+        policy: str = "balanced",
+        **kwargs,
+    ):
+        """Array-native whole-collective routing (oracle/batch.py).
+
+        ``macs`` lists unique endpoints once; ``src_idx``/``dst_idx`` are
+        [F] indices into it. Returns a ``CollectiveRoutes`` — per-pair
+        fdb lists are never materialized unless the caller asks. On the
+        JAX backend this is one resolve + one device program; the
+        pure-Python backend loops (differential oracle).
+        """
+        if self.backend == "jax":
+            return self._jax_oracle().routes_collective(
+                self, macs, src_idx, dst_idx, policy, **kwargs
+            )
+        import numpy as np
+
+        from sdnmpi_tpu.oracle.batch import CollectiveRoutes
+
+        src_idx = np.asarray(src_idx)
+        dst_idx = np.asarray(dst_idx)
+        f = len(src_idx)
+        fdbs = [
+            self.find_route(macs[int(s)], macs[int(d)])
+            for s, d in zip(src_idx, dst_idx)
+        ]
+        max_l = max((len(fdb) for fdb in fdbs), default=1) or 1
+        hop_dpid = np.full((f, max_l), -1, np.int64)
+        hop_port = np.full((f, max_l), -1, np.int32)
+        hop_len = np.zeros(f, np.int32)
+        final_port = np.full(f, -1, np.int32)
+        for k, fdb in enumerate(fdbs):
+            hop_len[k] = len(fdb)
+            for h, (dpid, port) in enumerate(fdb):
+                hop_dpid[k, h] = dpid
+                hop_port[k, h] = port
+            if fdb:
+                final_port[k] = fdb[-1][1]
+                hop_port[k, len(fdb) - 1] = -1  # per-pair placeholder
+        load: dict[tuple[int, int], float] = {}
+        for fdb in fdbs:
+            for (a, _), (b, _) in zip(fdb, fdb[1:]):
+                load[(a, b)] = load.get((a, b), 0.0) + 1.0
+        from sdnmpi_tpu.protocol.openflow import OFPP_LOCAL
+
+        endpoint_port = np.full(len(macs), -1, np.int32)
+        for i, mac in enumerate(macs):
+            host = self.hosts.get(mac)
+            if host is not None:
+                endpoint_port[i] = host.port.port_no
+            elif mac_to_int(mac) in self.switches:
+                endpoint_port[i] = OFPP_LOCAL
+        return CollectiveRoutes(
+            np.arange(f, dtype=np.int32), final_port, hop_dpid, hop_port,
+            hop_len, max_congestion=max(load.values(), default=0.0),
+            endpoint_port=endpoint_port,
+        )
 
     # -- backend dispatch ------------------------------------------------
 

@@ -88,6 +88,19 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.decode_announcements.restype = i64
         lib.encode_announcements.argtypes = [i32p, i32p, i64, u8p]
         lib.encode_announcements.restype = None
+        lib.deal_subflows.argtypes = [i32p, i32p, i32p, i32p, i64p, i64, i32p]
+        lib.deal_subflows.restype = None
+        lib.group_pairs.argtypes = [i32p, i32p, i32p, i64, i64, i64p, i64p]
+        lib.group_pairs.restype = None
+        lib.deal_subflows_keyed.argtypes = [
+            i64p, i32p, i32p, i64p, i32p, i64p, i64, i32p,
+        ]
+        lib.deal_subflows_keyed.restype = None
+        lib.scatter_members.argtypes = [
+            i32p, i32p, i32p, i64p, i64p, i64p, i64p, i32p,
+            i64, i64, i64, i64p, i64p, i64p, i64p, i32p,
+        ]
+        lib.scatter_members.restype = None
         _lib = lib
     except Exception:
         _lib = None
@@ -217,6 +230,139 @@ def materialize_fdbs(
         out_dpid, out_port, out_len,
     )
     return out_dpid, out_port, out_len
+
+
+def group_pairs(
+    src_idx: np.ndarray, dst_idx: np.ndarray, edge: np.ndarray, v: int
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Fused endpoint->edge grouping over a dense [V^2] key space.
+
+    Returns (key [F] int64 with -1 for unresolved pairs, counts_all
+    [V^2] int64), or None when the C++ library is unavailable — the
+    caller (oracle/engine.py) keeps the numpy formulation as fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    src_idx = np.ascontiguousarray(src_idx, np.int32)
+    dst_idx = np.ascontiguousarray(dst_idx, np.int32)
+    edge = np.ascontiguousarray(edge, np.int32)
+    key = np.empty(len(src_idx), np.int64)
+    counts_all = np.zeros(v * v, np.int64)
+    lib.group_pairs(src_idx, dst_idx, edge, len(src_idx), v, counts_all, key)
+    return key, counts_all
+
+
+def deal_subflows_keyed(
+    key: np.ndarray,
+    src_idx: np.ndarray,
+    dst_idx: np.ndarray,
+    lookup: np.ndarray,
+    nsub: np.ndarray,
+    sub_base: np.ndarray,
+) -> np.ndarray:
+    """group_pairs' companion deal (see deal_subflows for the hash
+    contract); key < 0 pairs come back as -1. C++ only — callers
+    without the library use the inv-based numpy path."""
+    lib = _load()
+    assert lib is not None, "deal_subflows_keyed requires the native library"
+    out = np.empty(len(key), np.int32)
+    lib.deal_subflows_keyed(
+        np.ascontiguousarray(key, np.int64),
+        np.ascontiguousarray(src_idx, np.int32),
+        np.ascontiguousarray(dst_idx, np.int32),
+        np.ascontiguousarray(lookup, np.int64),
+        np.ascontiguousarray(nsub, np.int32),
+        np.ascontiguousarray(sub_base, np.int64),
+        len(key), out,
+    )
+    return out
+
+
+def deal_subflows(
+    inv: np.ndarray,
+    src_idx: np.ndarray,
+    dst_idx: np.ndarray,
+    nsub: np.ndarray,
+    sub_base: np.ndarray,
+) -> np.ndarray:
+    """Deterministic hash deal of pairs onto their group's sub-flows.
+
+    Returns [F] int32 sub-flow ids. O(F), no sort; the same hash both
+    here and in the C++ kernel so engines agree bit-for-bit."""
+    lib = _load()
+    inv = np.ascontiguousarray(inv, np.int32)
+    src_idx = np.ascontiguousarray(src_idx, np.int32)
+    dst_idx = np.ascontiguousarray(dst_idx, np.int32)
+    nsub = np.ascontiguousarray(nsub, np.int32)
+    sub_base = np.ascontiguousarray(sub_base, np.int64)
+    f = len(inv)
+    if lib is None:  # numpy fallback, identical hash
+        h = (
+            src_idx.astype(np.uint32) * np.uint32(2654435761)
+        ) ^ (dst_idx.astype(np.uint32) * np.uint32(0x85EBCA77))
+        return (
+            sub_base[inv] + (h % nsub[inv].astype(np.uint32)).astype(np.int64)
+        ).astype(np.int32)
+    out = np.empty(f, np.int32)
+    lib.deal_subflows(inv, src_idx, dst_idx, nsub, sub_base, f, out)
+    return out
+
+
+def scatter_members(
+    pair_sub: np.ndarray,
+    src_idx: np.ndarray,
+    dst_idx: np.ndarray,
+    src_key_lut: np.ndarray,
+    vmac_src_lut: np.ndarray,
+    vmac_dst_lut: np.ndarray,
+    rewrite_lut: np.ndarray,
+    fport_lut: np.ndarray,
+    vmac_base: int,
+    n_subflows: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Counting-sort pairs by sub-flow, producing the contiguous member
+    arrays the block install needs: (bounds [S+1] int64, src keys, vMAC
+    keys, rewrite keys, final ports), each [F_routed] sorted so sub-flow
+    s's members are rows bounds[s]:bounds[s+1]. Pairs with pair_sub < 0
+    are dropped. All key production goes through per-endpoint LUTs."""
+    lib = _load()
+    pair_sub = np.ascontiguousarray(pair_sub, np.int32)
+    src_idx = np.ascontiguousarray(src_idx, np.int32)
+    dst_idx = np.ascontiguousarray(dst_idx, np.int32)
+    src_key_lut = np.ascontiguousarray(src_key_lut, np.int64)
+    vmac_src_lut = np.ascontiguousarray(vmac_src_lut, np.int64)
+    vmac_dst_lut = np.ascontiguousarray(vmac_dst_lut, np.int64)
+    rewrite_lut = np.ascontiguousarray(rewrite_lut, np.int64)
+    fport_lut = np.ascontiguousarray(fport_lut, np.int32)
+    f = len(pair_sub)
+    if lib is None:  # numpy fallback: stable argsort + LUT gathers
+        keep = pair_sub >= 0
+        order = np.argsort(pair_sub[keep], kind="stable")
+        si = src_idx[keep][order]
+        di = dst_idx[keep][order]
+        bounds = np.zeros(n_subflows + 1, np.int64)
+        np.cumsum(
+            np.bincount(pair_sub[keep], minlength=n_subflows), out=bounds[1:]
+        )
+        return (
+            bounds,
+            src_key_lut[si],
+            vmac_base | vmac_src_lut[si] | vmac_dst_lut[di],
+            rewrite_lut[di],
+            fport_lut[di],
+        )
+    n_routed = int((pair_sub >= 0).sum())
+    bounds = np.empty(n_subflows + 1, np.int64)
+    m_src = np.empty(n_routed, np.int64)
+    m_vmac = np.empty(n_routed, np.int64)
+    m_rewrite = np.empty(n_routed, np.int64)
+    m_fport = np.empty(n_routed, np.int32)
+    lib.scatter_members(
+        pair_sub, src_idx, dst_idx, src_key_lut, vmac_src_lut, vmac_dst_lut,
+        rewrite_lut, fport_lut, vmac_base, f, n_subflows,
+        bounds, m_src, m_vmac, m_rewrite, m_fport,
+    )
+    return bounds, m_src, m_vmac, m_rewrite, m_fport
 
 
 def decode_announcements(buf: bytes) -> tuple[np.ndarray, np.ndarray]:

@@ -91,6 +91,46 @@ class FlowMod:
 
 
 @dataclasses.dataclass(frozen=True)
+class FlowBlockSet:
+    """Batch flow install for an entire collective — S ECMP sub-flow
+    paths and their M member flows in ONE message of shared arrays.
+
+    Semantically this is the reference's per-hop FlowMod loop
+    (reference: sdnmpi/router.py:83-104) run for every member of every
+    sub-flow: member m of sub-flow s gets, at each path switch
+    ``hop_dpid[s, h]``, an exact-match flow ``(dl_src=src[m],
+    dl_dst=dst[m]) -> output(hop_port[s, h])``; at the final hop
+    (``h == hop_len[s] - 1``) the member instead outputs to its own
+    ``final_port[m]`` (the destination host's attachment port), first
+    rewriting dl_dst to ``rewrite[m]`` (virtual -> real MAC, reference:
+    sdnmpi/router.py:98-102). MACs travel as int48 keys
+    (``utils.mac.mac_to_int`` form), never strings.
+
+    Sub-flow s's members are rows ``bounds[s]:bounds[s+1]`` of the
+    member arrays (the native counting-sort layout), so the message is
+    O(S x L + M) memory for S*L x M worth of switch flow entries.
+    ``cookie`` identifies the install for bulk teardown.
+
+    Known shape limit, shared with any per-switch exact-match scheme
+    (including the reference's): a path that visits the same switch
+    twice cannot install two different next hops for one (src, dst)
+    match — implementations keep the later hop, shortcutting the
+    revisit loop.
+    """
+
+    hop_dpid: "object"  # [S, L] int64 (-1 padded)
+    hop_port: "object"  # [S, L] int32 transit out-ports
+    hop_len: "object"  # [S] int32
+    bounds: "object"  # [S + 1] int64 member-slice offsets
+    src: "object"  # [M] int64 member source MAC keys
+    dst: "object"  # [M] int64 member destination (virtual) MAC keys
+    final_port: "object"  # [M] int32 per-member final out-port
+    rewrite: Optional["object"] = None  # [M] int64 true-dst MAC keys
+    priority: int = 0x8000
+    cookie: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class PacketOut:
     data: "Packet"
     actions: tuple[Action, ...]

@@ -66,3 +66,28 @@ class VirtualMac:
         coll_type = raw[0] >> 2
         src_rank, dst_rank = struct.unpack("<hh", raw[2:6])
         return cls(coll_type, src_rank, dst_rank)
+
+
+def encode_batch_ints(coll_type: int, src_ranks, dst_ranks) -> "object":
+    """Vectorized vMAC encoding to int48 MAC keys ([F] int64 numpy).
+
+    Same byte layout as :meth:`VirtualMac.encode` (big-endian MAC int of
+    bytes b0..b5; ranks little-endian int16 at bytes 2-3 / 4-5), produced
+    with array ops so a whole collective's F rank pairs encode in one
+    shot — the per-pair string form is only materialized where a string
+    API needs it (utils.mac.ints_to_macs).
+    """
+    import numpy as np
+
+    if not 0 <= coll_type < 64:
+        raise ValueError(f"coll_type must fit in 6 bits: {coll_type}")
+    src = np.asarray(src_ranks, dtype=np.int64) & 0xFFFF
+    dst = np.asarray(dst_ranks, dtype=np.int64) & 0xFFFF
+    b0 = np.int64(((coll_type << 2) | 0x02) << 40)
+    return (
+        b0
+        | ((src & 0xFF) << 24)  # byte 2: src low
+        | ((src >> 8) << 16)  # byte 3: src high
+        | ((dst & 0xFF) << 8)  # byte 4: dst low
+        | (dst >> 8)  # byte 5: dst high
+    )

@@ -33,6 +33,34 @@ def bytes_to_mac(raw: bytes) -> str:
     return ":".join(f"{b:02x}" for b in raw)
 
 
+def macs_to_ints(macs) -> "np.ndarray":
+    """Vectorized ``mac_to_int`` over a sequence -> [N] int64.
+
+    N is the number of *unique endpoints* (hosts/ranks), not flows, so a
+    Python loop here is fine — the flow-scale arrays downstream index
+    into this."""
+    import numpy as np
+
+    return np.array([int(m.replace(":", ""), 16) for m in macs], dtype=np.int64)
+
+
+def ints_to_macs(values: "np.ndarray") -> "np.ndarray":
+    """Vectorized ``int_to_mac``: [N] int64 -> [N] str array.
+
+    Byte-sliced through a 256-entry hex lookup table — no per-element
+    Python formatting, so encoding millions of flow MACs stays in numpy.
+    """
+    import numpy as np
+
+    values = np.asarray(values, dtype=np.int64)
+    lut = np.array([f"{i:02x}" for i in range(256)])
+    sep = np.array(":")
+    out = lut[(values >> 40) & 0xFF]
+    for shift in (32, 24, 16, 8, 0):
+        out = np.char.add(np.char.add(out, sep), lut[(values >> shift) & 0xFF])
+    return out
+
+
 def is_broadcast(mac: str) -> bool:
     return mac.lower() == BROADCAST_MAC
 

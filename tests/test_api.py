@@ -46,8 +46,11 @@ class TestRPCMirror:
         fabric, controller, rpc = make_stack()
         client = FakeClient()
         rpc.attach_client(client)
-        # same init sequence as the reference (rpc_interface.py:34-40)
-        assert client.methods() == ["init_fdb", "init_rankdb", "init_topologydb"]
+        # the reference's init sequence (rpc_interface.py:34-40) plus the
+        # collectives summary extension
+        assert client.methods() == [
+            "init_fdb", "init_rankdb", "init_topologydb", "init_collectives",
+        ]
         topo = client.messages[2]["params"][0]
         assert len(topo["switches"]) == 4
         assert len(topo["links"]) == 8
@@ -137,7 +140,7 @@ class TestWebSocketTransport:
                 # trigger an event after connect
                 await asyncio.sleep(0.1)
                 announce(fabric, MAC[1], AnnouncementType.LAUNCH, 3)
-                for _ in range(4):  # 3 init + 1 add_process
+                for _ in range(5):  # 4 init + 1 add_process
                     messages.append(json.loads(await asyncio.wait_for(ws.recv(), 5)))
             server_task.cancel()
             return messages
@@ -147,9 +150,10 @@ class TestWebSocketTransport:
             "init_fdb",
             "init_rankdb",
             "init_topologydb",
+            "init_collectives",
             "add_process",
         ]
-        assert messages[3]["params"] == [3, MAC[1]]
+        assert messages[4]["params"] == [3, MAC[1]]
 
 
 class TestCheckpoint:

@@ -1,0 +1,239 @@
+"""End-to-end tests of the array-native proactive collective install.
+
+The block path is the scaled form of the proactive install: rank pairs
+stay in index arrays, MACs/vMACs are int48 keys, and each ECMP sub-flow's
+shared path is ONE FlowPathBlock. These tests force it on at toy scale
+(block_install_threshold=1) and drive the full stack — announcements,
+kickoff packet-in, block install, data-plane delivery with last-hop
+rewrite, link-failure re-route, process-exit teardown — mirroring what
+tests/test_control.py pins for the reference-shaped per-pair path.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+from sdnmpi_tpu.topogen import fattree
+
+N_RANKS = 8
+
+
+def make_stack(**config_kw):
+    spec = fattree(4)  # 20 switches, 16 hosts
+    fabric = spec.to_fabric()
+    config = Config(block_install_threshold=1, **config_kw)
+    controller = Controller(fabric, config)
+    controller.attach()
+    macs = sorted(fabric.hosts)[:N_RANKS]
+    for rank, mac in enumerate(macs):
+        pkt = of.Packet(
+            eth_src=mac,
+            eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP,
+            ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        )
+        fabric.hosts[mac].send(pkt)
+    return fabric, controller, macs
+
+
+def kickoff(fabric, macs, coll_type=CollectiveType.ALLTOALL, src=0, dst=1):
+    vmac = VirtualMac(coll_type, src, dst).encode()
+    fabric.hosts[macs[src]].send(
+        of.Packet(eth_src=macs[src], eth_dst=vmac, eth_type=of.ETH_TYPE_IP)
+    )
+
+
+def send_pair(fabric, macs, coll_type, s, d):
+    vmac = VirtualMac(coll_type, s, d).encode()
+    fabric.hosts[macs[s]].send(
+        of.Packet(eth_src=macs[s], eth_dst=vmac, eth_type=of.ETH_TYPE_IP)
+    )
+
+
+class TestBlockInstall:
+    def test_alltoall_installs_blocks_and_delivers(self):
+        fabric, controller, macs = make_stack()
+        installed = []
+        controller.bus.subscribe(
+            ev.EventCollectiveInstalled, lambda e: installed.append(e)
+        )
+        kickoff(fabric, macs)
+
+        assert len(installed) == 1
+        event = installed[0]
+        assert event.n_pairs == N_RANKS * (N_RANKS - 1)
+        assert event.n_flows > 0
+        table = controller.router.collectives
+        assert len(table) == 1
+        install = next(iter(table))
+        assert install.n_pairs == N_RANKS * (N_RANKS - 1)
+
+        # data plane: every rank pair delivers via block flows, with the
+        # last hop rewriting the virtual MAC to the true host MAC
+        # (reference: sdnmpi/router.py:98-102)
+        for s in range(N_RANKS):
+            for d in range(N_RANKS):
+                if s == d:
+                    continue
+                before = len(fabric.hosts[macs[d]].received)
+                send_pair(fabric, macs, CollectiveType.ALLTOALL, s, d)
+                got = fabric.hosts[macs[d]].received[before:]
+                assert got, f"pair {s}->{d} not delivered"
+                assert got[-1].eth_dst == macs[d]
+
+    def test_kickoff_is_idempotent(self):
+        fabric, controller, macs = make_stack()
+        kickoff(fabric, macs)
+        cookie = next(iter(controller.router.collectives)).cookie
+        kickoff(fabric, macs, src=2, dst=3)  # same collective, other pair
+        assert len(controller.router.collectives) == 1
+        assert next(iter(controller.router.collectives)).cookie == cookie
+
+    def test_congestion_metric_matches_routes(self):
+        fabric, controller, macs = make_stack()
+        kickoff(fabric, macs)
+        install = next(iter(controller.router.collectives))
+        assert install.max_congestion > 0
+
+    def test_link_failure_reroutes_collective(self):
+        fabric, controller, macs = make_stack()
+        kickoff(fabric, macs)
+        cookie0 = next(iter(controller.router.collectives)).cookie
+
+        # kill one core uplink; revalidation must reinstall the
+        # collective against the surviving topology
+        removed = []
+        controller.bus.subscribe(
+            ev.EventCollectiveRemoved, lambda e: removed.append(e)
+        )
+        a, pa, b, pb = next(
+            l for l in fabric.links
+            if not any(
+                p.peer and p.peer[0] == "host"
+                for p in fabric.switches[l[0]].ports.values()
+            )
+        )
+        fabric.remove_link(a, pa, b, pb)
+
+        assert removed and removed[0].cookie == cookie0
+        assert len(controller.router.collectives) == 1
+        assert next(iter(controller.router.collectives)).cookie != cookie0
+        for s, d in [(0, 7), (3, 4), (6, 1)]:
+            before = len(fabric.hosts[macs[d]].received)
+            send_pair(fabric, macs, CollectiveType.ALLTOALL, s, d)
+            assert len(fabric.hosts[macs[d]].received) > before
+
+    def test_process_exit_tears_down_blocks(self):
+        fabric, controller, macs = make_stack()
+        kickoff(fabric, macs)
+        assert len(controller.router.collectives) == 1
+
+        pkt = of.Packet(
+            eth_src=macs[2],
+            eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP,
+            ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.EXIT, 2).encode(),
+        )
+        fabric.hosts[macs[2]].send(pkt)
+        assert len(controller.router.collectives) == 0
+        # block flows are gone from every switch
+        assert all(not sw.block_table for sw in fabric.switches.values())
+
+    def test_block_and_string_paths_deliver_identically(self):
+        """The threshold only changes the install mechanism, not the
+        outcome: every pair delivers under either engine."""
+        results = {}
+        for name, threshold in (("blocks", 1), ("strings", 10**9)):
+            spec_pairs = []
+            fabric, controller, macs = make_stack()
+            controller.config.block_install_threshold = threshold
+            controller.router.config.block_install_threshold = threshold
+            kickoff(fabric, macs)
+            for s in range(N_RANKS):
+                for d in range(N_RANKS):
+                    if s == d:
+                        continue
+                    before = len(fabric.hosts[macs[d]].received)
+                    send_pair(fabric, macs, CollectiveType.ALLTOALL, s, d)
+                    spec_pairs.append(
+                        len(fabric.hosts[macs[d]].received) > before
+                    )
+            results[name] = spec_pairs
+        assert all(results["blocks"])
+        assert results["blocks"] == results["strings"]
+
+
+class TestCollectiveCheckpoint:
+    def test_block_install_survives_snapshot_restore(self):
+        """A block-installed collective round-trips the checkpoint: the
+        restored controller re-routes it against its own topology (with
+        the snapshotted policy) and the data plane delivers."""
+        import json
+
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+
+        fabric, controller, macs = make_stack(collective_policy="adaptive")
+        kickoff(fabric, macs)
+        snap = json.loads(json.dumps(snapshot_controller(controller)))
+        assert snap["collectives"][0]["policy"] == "adaptive"
+
+        spec = fattree(4)
+        fresh_fabric = spec.to_fabric()
+        # restored controller runs a different default policy: the
+        # snapshot's policy must win for the restored install
+        fresh = Controller(fresh_fabric, Config(block_install_threshold=1))
+        fresh.attach()
+        restore_controller(fresh, snap)
+
+        table = fresh.router.collectives
+        assert len(table) == 1
+        install = next(iter(table))
+        assert install.policy == "adaptive"
+        assert install.n_pairs == N_RANKS * (N_RANKS - 1)
+        for s, d in [(0, 5), (4, 2), (7, 1)]:
+            before = len(fresh_fabric.hosts[macs[d]].received)
+            send_pair(fresh_fabric, macs, CollectiveType.ALLTOALL, s, d)
+            got = fresh_fabric.hosts[macs[d]].received[before:]
+            assert got and got[-1].eth_dst == macs[d]
+
+
+class TestCollectiveRoutesAPI:
+    def test_routes_collective_matches_list_api(self):
+        """The array API and the list API agree pairwise on fdbs for the
+        shortest policy (deterministic next hops)."""
+        db = fattree(4).to_topology_db(backend="jax")
+        macs = sorted(db.hosts)[:6]
+        src_idx, dst_idx = [], []
+        for i in range(len(macs)):
+            for j in range(len(macs)):
+                if i != j:
+                    src_idx.append(i)
+                    dst_idx.append(j)
+        routes = db.find_routes_collective(
+            macs, np.array(src_idx), np.array(dst_idx), policy="shortest"
+        )
+        pairs = [(macs[i], macs[j]) for i, j in zip(src_idx, dst_idx)]
+        expected = db.find_routes_batch(pairs)
+        assert routes.fdbs() == expected
+
+    def test_unresolved_endpoints_unrouted(self):
+        db = fattree(4).to_topology_db(backend="jax")
+        macs = sorted(db.hosts)[:2] + ["de:ad:be:ef:00:00"]
+        routes = db.find_routes_collective(
+            macs, np.array([0, 0]), np.array([1, 2]), policy="balanced"
+        )
+        mask = routes.routed_mask()
+        assert mask[0] and not mask[1]
+        assert routes.fdb(1) == []
