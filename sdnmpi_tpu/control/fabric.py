@@ -123,7 +123,21 @@ class SimSwitch:
         self.flow_table: list[_FlowEntry] = []
         self.block_table: list[_BlockSetEntry] = []
         self.local_delivered: list[of.Packet] = []  # OFPP_LOCAL sink
+        #: packets parked switch-side while the controller decides
+        #: (real OF 1.0 switches buffer the frame and send the controller
+        #: a buffer_id; reference packet-outs reuse it, router.py:111-118)
+        self.buffers: dict[int, of.Packet] = {}
+        self._next_buffer = 0
         self._seq = 0
+
+    MAX_BUFFERS = 1024  # FIFO cap, like a real switch's finite buffer pool
+
+    def buffer_packet(self, pkt: of.Packet) -> int:
+        self._next_buffer += 1
+        self.buffers[self._next_buffer] = pkt
+        while len(self.buffers) > self.MAX_BUFFERS:
+            self.buffers.pop(next(iter(self.buffers)))
+        return self._next_buffer
 
     def port(self, port_no: int) -> SimPort:
         return self.ports.setdefault(port_no, SimPort(port_no))
@@ -191,8 +205,11 @@ class SimSwitch:
         if entry is None:
             # table miss -> controller (the reference runs ryu-manager with
             # --noexplicit-drop so unmatched packets reach the apps,
-            # run_router.sh:2)
-            self.fabric.packet_in(self.dpid, in_port, pkt)
+            # run_router.sh:2); the frame is parked in the switch buffer
+            # and its id rides the packet-in, as OF 1.0 switches do
+            self.fabric.packet_in(
+                self.dpid, in_port, pkt, self.buffer_packet(pkt)
+            )
             return
         self.apply_actions(entry.actions, pkt, in_port, hops)
 
@@ -215,7 +232,7 @@ class SimSwitch:
 
     def _output(self, port_no: int, pkt: of.Packet, in_port: int, hops: int) -> None:
         if port_no == of.OFPP_CONTROLLER:
-            self.fabric.packet_in(self.dpid, in_port, pkt)
+            self.fabric.packet_in(self.dpid, in_port, pkt, self.buffer_packet(pkt))
             return
         if port_no == of.OFPP_LOCAL:
             self.local_delivered.append(pkt)
@@ -278,12 +295,21 @@ class Fabric:
             self.bus.publish(EventSwitchEnter(sw.to_entity()))
         return sw
 
+    def _port_added(self, dpid: int) -> None:
+        """Re-announce a switch whose port set grew, so the controller's
+        topology view tracks live ports (Ryu's port-add events play this
+        role; TopologyDB.add_switch upserts by dpid)."""
+        if self.bus is not None:
+            self.bus.publish(EventSwitchEnter(self.switches[dpid].to_entity()))
+
     def add_link(self, a: int, port_a: int, b: int, port_b: int) -> None:
         """Bidirectional link a:port_a <-> b:port_b (LLDP discovery reports
         both directed halves, as the reference's TopologyDB stores them)."""
         self.switches[a].port(port_a).peer = ("switch", b, port_b)
         self.switches[b].port(port_b).peer = ("switch", a, port_a)
         self.links.append((a, port_a, b, port_b))
+        self._port_added(a)
+        self._port_added(b)
         if self.bus is not None:
             for link in self._link_entities(a, port_a, b, port_b):
                 self.bus.publish(EventLinkAdd(link))
@@ -292,8 +318,22 @@ class Fabric:
         host = SimHost(self, mac, dpid, port_no)
         self.hosts[mac] = host
         self.switches[dpid].port(port_no).peer = ("host", mac)
+        self._port_added(dpid)
         if self.bus is not None:
             self.bus.publish(EventHostAdd(host.to_entity()))
+        return host
+
+    def add_silent_host(self, mac: str, dpid: int, port_no: int) -> SimHost:
+        """A host cabled to a switch port that discovery has NOT seen
+        (it has never sent a packet). The port exists on the switch —
+        which is exactly why broadcasts must flood all non-inter-switch
+        ports (reference: sdnmpi/topology.py:157-177), not just ports
+        with discovered hosts: this host must still be reachable by the
+        broadcast that would bootstrap it."""
+        host = SimHost(self, mac, dpid, port_no)
+        self.hosts[mac] = host
+        self.switches[dpid].port(port_no).peer = ("host", mac)
+        self._port_added(dpid)
         return host
 
     @staticmethod
@@ -399,7 +439,19 @@ class Fabric:
             sw.remove_blocks(cookie)
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
-        self.switches[dpid].apply_actions(out.actions, out.data, out.in_port, hops=0)
+        sw = self.switches[dpid]
+        pkt = out.data
+        if out.buffer_id != of.OFP_NO_BUFFER:
+            # use the switch-side buffered frame (reference:
+            # sdnmpi/router.py:111-118); data, if any, is ignored
+            pkt = sw.buffers.pop(out.buffer_id, None)
+            if pkt is None:
+                log.debug(
+                    "packet_out for unknown buffer %s on dpid %s dropped",
+                    out.buffer_id, dpid,
+                )
+                return
+        sw.apply_actions(out.actions, pkt, out.in_port, hops=0)
 
     def port_stats(self, dpid: int) -> list[of.PortStatsEntry]:
         return self.switches[dpid].port_stats()
@@ -409,9 +461,15 @@ class Fabric:
 
     # -- internal transit -------------------------------------------------
 
-    def packet_in(self, dpid: int, in_port: int, pkt: of.Packet) -> None:
+    def packet_in(
+        self,
+        dpid: int,
+        in_port: int,
+        pkt: of.Packet,
+        buffer_id: int = of.OFP_NO_BUFFER,
+    ) -> None:
         if self.bus is not None:
-            self.bus.publish(EventPacketIn(dpid, in_port, pkt, of.OFP_NO_BUFFER))
+            self.bus.publish(EventPacketIn(dpid, in_port, pkt, buffer_id))
 
     def transmit(self, peer: tuple, pkt: of.Packet, hops: int) -> None:
         if hops >= _MAX_HOPS:

@@ -117,13 +117,24 @@ class Router:
                 self._add_flow(dpid, src, dst, out_port)
 
     def _send_packet_out(
-        self, fdb: list[tuple[int, int]], dpid: int, pkt: of.Packet
+        self,
+        fdb: list[tuple[int, int]],
+        dpid: int,
+        pkt: of.Packet,
+        buffer_id: int = of.OFP_NO_BUFFER,
     ) -> None:
-        """Emit the triggering packet from the ingress switch only
-        (reference: router.py:106-123)."""
+        """Emit the triggering packet from the ingress switch only,
+        reusing the switch-side buffer when the packet-in carried one —
+        the frame is not re-sent over the control channel (reference:
+        router.py:106-123, buffer handling at :111-118)."""
         for entry_dpid, out_port in fdb:
             if entry_dpid == dpid:
-                out = of.PacketOut(data=pkt, actions=(of.ActionOutput(out_port),))
+                buffered = buffer_id != of.OFP_NO_BUFFER
+                out = of.PacketOut(
+                    data=None if buffered else pkt,
+                    actions=(of.ActionOutput(out_port),),
+                    buffer_id=buffer_id,
+                )
                 self.southbound.packet_out(dpid, out)
                 break
 
@@ -147,7 +158,7 @@ class Router:
         fdb = self.bus.request(ev.FindRouteRequest(src, dst)).fdb
         if fdb:
             self._add_flows_for_path(fdb, src, dst)
-            self._send_packet_out(fdb, event.dpid, pkt)
+            self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
         else:
             self.bus.request(ev.BroadcastRequest(pkt, event.dpid, event.in_port))
 
@@ -170,7 +181,7 @@ class Router:
         fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
         if fdb:
             self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
-            self._send_packet_out(fdb, event.dpid, pkt)
+            self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
 
         if self.config.proactive_collectives and vmac.coll_type != CollectiveType.P2P:
             self._install_collective(vmac)

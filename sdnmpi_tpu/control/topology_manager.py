@@ -175,24 +175,29 @@ class TopologyManager:
     # -- broadcast (reference: sdnmpi/topology.py:150-177) ----------------
 
     def _do_broadcast(self, pkt: of.Packet, src_dpid: int, src_in_port: int) -> None:
-        """Flood to every host-facing (edge) port in the network, excluding
-        the ingress port. The reference flood-lists each switch's ports
-        minus inter-switch and reserved ports (topology.py:163-168); the
-        observable set — ports with hosts behind them — is what the
-        topology db already knows."""
-        by_dpid: dict[int, list[int]] = {}
-        for host in self.topologydb.hosts.values():
-            by_dpid.setdefault(host.port.dpid, []).append(host.port.port_no)
-
-        for dpid in sorted(by_dpid):
-            if dpid not in self.topologydb.switches:
-                continue
-            ports = by_dpid[dpid]
+        """Flood to every edge port in the network — any switch port
+        without an inter-switch link (and below the reserved range) —
+        excluding the ingress port, exactly the reference's flood set
+        (topology.py:157-177, ``_is_edge_port`` at :163-168). Flooding
+        only *discovered-host* ports would strand a host that has never
+        sent a packet: it could never receive the broadcast that
+        bootstraps it."""
+        for dpid in sorted(self.topologydb.switches):
+            switch = self.topologydb.switches[dpid]
+            inter = {
+                link.src.port_no
+                for link in self.topologydb.links.get(dpid, {}).values()
+            }
+            ports = sorted(
+                p.port_no
+                for p in getattr(switch, "ports", [])
+                if p.port_no not in inter and p.port_no < of.OFPP_MAX
+            )
             if dpid == src_dpid:
                 ports = [p for p in ports if p != src_in_port]
             if not ports:
                 continue
-            actions = tuple(of.ActionOutput(p) for p in sorted(ports))
+            actions = tuple(of.ActionOutput(p) for p in ports)
             self.southbound.packet_out(dpid, of.PacketOut(data=pkt, actions=actions))
 
     # -- utilization ingest -----------------------------------------------
