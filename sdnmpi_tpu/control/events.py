@@ -62,6 +62,18 @@ class EventSwitchLeave(Event):
 
 
 @dataclasses.dataclass
+class EventPortAdd(Event):
+    """A known switch grew a port (Ryu's EventPortAdd plays this role).
+    Carries the switch's refreshed entity so TopologyDB can upsert its
+    port set; deliberately distinct from EventSwitchEnter so the RPC
+    mirror does not re-broadcast ``add_switch`` for every cabling change
+    (the reference's feed announces a switch once,
+    sdnmpi/rpc_interface.py:56-60)."""
+
+    switch: Any
+
+
+@dataclasses.dataclass
 class EventLinkAdd(Event):
     link: Any
 

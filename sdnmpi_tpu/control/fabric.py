@@ -23,6 +23,7 @@ from sdnmpi_tpu.control.events import (
     EventLinkAdd,
     EventLinkDelete,
     EventPacketIn,
+    EventPortAdd,
     EventSwitchEnter,
     EventSwitchLeave,
     EventTopologyChanged,
@@ -296,11 +297,13 @@ class Fabric:
         return sw
 
     def _port_added(self, dpid: int) -> None:
-        """Re-announce a switch whose port set grew, so the controller's
+        """Announce a switch whose port set grew, so the controller's
         topology view tracks live ports (Ryu's port-add events play this
-        role; TopologyDB.add_switch upserts by dpid)."""
+        role; TopologyDB.add_switch upserts by dpid). A dedicated event —
+        not a replayed EventSwitchEnter — so the RPC mirror does not emit
+        a redundant ``add_switch`` per cabling change."""
         if self.bus is not None:
-            self.bus.publish(EventSwitchEnter(self.switches[dpid].to_entity()))
+            self.bus.publish(EventPortAdd(self.switches[dpid].to_entity()))
 
     def add_link(self, a: int, port_a: int, b: int, port_b: int) -> None:
         """Bidirectional link a:port_a <-> b:port_b (LLDP discovery reports

@@ -52,6 +52,7 @@ class TopologyManager:
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventSwitchEnter, lambda e: self.topologydb.add_switch(e.switch))
+        bus.subscribe(ev.EventPortAdd, lambda e: self.topologydb.add_switch(e.switch))
         bus.subscribe(ev.EventSwitchLeave, lambda e: self.topologydb.delete_switch(e.switch))
         bus.subscribe(ev.EventLinkAdd, lambda e: self.topologydb.add_link(e.link))
         bus.subscribe(ev.EventLinkDelete, lambda e: self.topologydb.delete_link(e.link))
