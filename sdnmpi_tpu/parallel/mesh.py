@@ -253,6 +253,126 @@ def route_adaptive_sharded(
     return inner(adj, dist_arg, util, src, dst, weight, jnp.int32(n_valid))
 
 
+def route_collective_sharded(
+    adj: jax.Array,  # [V, V] 0/1 (replicated)
+    link_src: jax.Array,  # [E] int32 row index of each real link
+    link_dst: jax.Array,  # [E] int32 col index
+    link_util: jax.Array,  # [E] f32 measured utilization per link
+    traffic: jax.Array,  # [V, V] f32 traffic[t, i] — T axis sharded
+    src: jax.Array,  # [F] int32 flow sources (-1 pad) — sharded
+    dst: jax.Array,  # [F] int32 flow destinations — sharded
+    mesh: Mesh,
+    levels: int,
+    rounds: int,
+    max_len: int,
+    salt: int = 0,
+    dist: jax.Array | None = None,  # cached APSP distances, else computed
+) -> tuple[jax.Array, jax.Array]:
+    """The flagship MXU DAG engine (oracle/dag.route_collective) sharded
+    over every device of the mesh ("flow" x "v" axes flattened).
+
+    Sharding follows the engine's own structure:
+
+    - ``propagate_levels`` is [T, V] x [V, V] matmuls masked by the
+      destination-distance levels — embarrassingly parallel over the T
+      (destination) axis. Each device propagates the traffic destined to
+      its own block of switches and the per-link loads are ``psum``-ed
+      (one [V, V] all-reduce over ICI per balance round), so the
+      congestion reweighting sees the SAME global load matrix as the
+      single-device path.
+    - ``sample_paths_dense`` is embarrassingly parallel over flows; each
+      shard samples its slice with ``fid_base`` set to the slice's global
+      offset, so every flow draws the same Gumbel noise stream as on one
+      device.
+    - If no cached ``dist`` is passed, APSP runs row-sharded
+      (``apsp_distances_sharded``) and XLA all-gathers the blocks into
+      the replicated distance matrix the DAG stages need.
+
+    Exact hop-count distances and the dyadic splits of idle fat-trees
+    make the sharded slots bit-identical to ``route_collective``'s (see
+    tests/test_mesh_dag.py); the congestion figure may differ by ulps
+    because the psum and the single-device matmul reduce in different
+    orders.
+
+    Returns ``(slots [F, sampled_hops(max_len)] int8, max_congestion
+    f32 scalar)`` — the unpacked form of ``route_collective``'s buffer;
+    decode with ``slots_to_nodes(..., complete=True)``. Requires V and F
+    divisible by the total shard count. Reference seam: this serves the
+    whole-collective request of sdnmpi/topology.py:138-142 at the scale
+    axis of SURVEY §5.
+    """
+    from sdnmpi_tpu.oracle.dag import (
+        congestion_weights,
+        propagate_levels,
+        sample_paths_dense,
+        sampled_hops,
+    )
+
+    v = adj.shape[0]
+    f = src.shape[0]
+    n_shards = mesh.shape["flow"] * mesh.shape["v"]
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by {n_shards} shards")
+    if f % n_shards:
+        raise ValueError(f"flow count {f} must divide by {n_shards} shards")
+    have_dist = dist is not None
+    dist_arg = dist if have_dist else jnp.zeros_like(adj, dtype=jnp.float32)
+    hops = sampled_hops(max_len)
+
+    @jax.jit
+    def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in):
+        base = (
+            jnp.zeros((v, v), jnp.float32)
+            .at[link_src, link_dst]
+            .set(link_util, unique_indices=True, mode="drop")
+        )
+        d = dist_in if have_dist else apsp_distances_sharded(adj, mesh)
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(None, None),  # adj
+                P(None, None),  # dist (replicated: sampler walks all of it)
+                P(("flow", "v"), None),  # dist.T rows for this T block
+                P(None, None),  # base cost
+                P(("flow", "v"), None),  # traffic T block
+                P(("flow", "v")),  # src slice
+                P(("flow", "v")),  # dst slice
+            ),
+            out_specs=(P(("flow", "v"), None), P(None, None)),
+            check_vma=False,  # psum-derived outputs are replicated
+        )
+        def inner(a, d_full, d_t_local, base, traffic_local, s, t):
+            adj_f = (a > 0).astype(jnp.float32)
+            weights = congestion_weights(adj_f, base)
+            load = lax.psum(
+                propagate_levels(weights, d_t_local, traffic_local, levels),
+                ("flow", "v"),
+            )
+            for _ in range(rounds - 1):
+                weights = congestion_weights(adj_f, base + load)
+                load = lax.psum(
+                    propagate_levels(weights, d_t_local, traffic_local, levels),
+                    ("flow", "v"),
+                )
+            maxc = jnp.max(load)
+
+            shard_idx = (
+                lax.axis_index("flow") * mesh.shape["v"] + lax.axis_index("v")
+            )
+            fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
+            _, slots = sample_paths_dense(
+                weights, d_full, s, t, hops, salt=salt, fid_base=fid_base
+            )
+            return slots, maxc[None, None]
+
+        slots, maxc = inner(adj, d, d.T, base, traffic, src, dst)
+        return slots, maxc[0, 0]
+
+    return step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg)
+
+
 def multichip_route_step(
     adj: jax.Array,
     base_cost: jax.Array,
