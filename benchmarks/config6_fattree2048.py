@@ -1,0 +1,117 @@
+"""Config 6: 8192-rank MPI_Alltoall on a fat-tree k=32, V padded to 2048.
+
+Past the flagship config's V=1024 ceiling: 1,280 real switches padded to
+V=2048, where the f32 adjacency alone (16 MB) no longer fits VMEM — the
+Pallas kernels run on their bf16 + column-sliced formulation
+(kernels/bfs.py budget notes). The 8192 ranks cover all 512 edge
+switches, so the aggregated collective is 512 x 511 = 261,632 device
+flows routed in one program.
+
+Reported value: steady-state per-collective route latency (pipelined
+stream, like bench.py). vs_baseline: max-link congestion of naive
+deterministic single-path routing / the balanced routing's congestion
+(how much the load-aware ECMP flattens the hot link at this scale).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from benchmarks.common import emit, log, stream_throughput
+from sdnmpi_tpu.oracle.adaptive import link_loads
+from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
+from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.oracle.paths import batch_paths
+from sdnmpi_tpu.topogen import fattree
+
+N_RANKS = 8192
+K = 32
+V_PAD = 2048
+
+
+def main() -> None:
+    import jax
+
+    from sdnmpi_tpu.kernels.bfs import pallas_supported
+    from sdnmpi_tpu.kernels.sampler import sampler_supported
+
+    spec = fattree(K)
+    db = spec.to_topology_db(backend="jax", pad_multiple=V_PAD)
+    t = tensorize(db, pad_multiple=V_PAD)
+    v = t.adj.shape[0]
+    adj = np.asarray(t.adj)
+    log(f"fattree k={K}: {spec.n_switches} switches (padded {v}), "
+        f"{spec.n_hosts} hosts")
+
+    host_edge = np.array(
+        [t.index[dpid] for _, dpid, _ in spec.hosts[:N_RANKS]], np.int32
+    )
+    # aggregate analytically: an alltoall's (src_edge, dst_edge) weight is
+    # ranks_on_src_edge x ranks_on_dst_edge — no need to materialize the
+    # 67M-pair expansion that aggregate_pairs would count (same output
+    # order: lexicographic over sorted edge ids)
+    edges, counts = np.unique(host_edge, return_counts=True)
+    ga, gb = np.meshgrid(edges, edges, indexing="ij")
+    wa, wb = np.meshgrid(counts, counts, indexing="ij")
+    off = ga != gb
+    usrc = ga[off].astype(np.int32)
+    udst = gb[off].astype(np.int32)
+    weight = (wa[off] * wb[off]).astype(np.float32)
+    n_rank_pairs = N_RANKS * N_RANKS - int((counts**2).sum())
+    log(f"alltoall: {n_rank_pairs:,} rank pairs -> {len(usrc):,} edge flows")
+
+    dist_d = apsp_distances(t.adj)
+    dist_h = np.asarray(dist_d)
+    levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
+    max_len = levels + 1
+    log(f"diameter {levels}; fast path: bfs={pallas_supported(v)} "
+        f"sampler={sampler_supported(v, max_len - 2, n_flows=len(usrc))}")
+    li, lj = np.nonzero(adj > 0)
+    rng = np.random.default_rng(0)
+    util = (rng.random(len(li)) * 2e9).astype(np.float32)  # monitor-style bps
+    traffic = np.zeros((v, v), np.float32)
+    traffic[udst, usrc] = weight
+
+    args = [
+        t.adj, jax.device_put(li.astype(np.int32)),
+        jax.device_put(lj.astype(np.int32)), jax.device_put(util),
+        jax.device_put(traffic), jax.device_put(usrc), jax.device_put(udst),
+    ]
+    # dist passed from the topology-version cache, as the engine does
+    kw = dict(levels=levels, rounds=2, max_len=max_len,
+              max_degree=t.max_degree, dist=dist_d)
+
+    def run():
+        return np.asarray(route_collective(*args, **kw))
+
+    buf = run()  # compile + warm
+    run()
+
+    def dispatch_fetch(i):
+        b = route_collective(*args, **kw)
+        try:
+            b.copy_to_host_async()
+        except Exception:
+            pass
+        return np.asarray(b)
+
+    t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    slots, maxc = unpack_result(buf, len(usrc), max_len)
+    nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
+    assert (nodes[:, 0] == usrc).all()
+    load = link_loads(nodes, weight, v)
+
+    nxt = apsp_next_hops(t.adj, dist_d)
+    naive, _ = batch_paths(nxt, jax.device_put(usrc), jax.device_put(udst), max_len)
+    naive_load = link_loads(np.asarray(naive), weight, v)
+    log(f"route {t_route_ms:.2f} ms; max congestion balanced "
+        f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
+    emit(
+        "alltoall8192_fattree2048_route_ms", t_route_ms, "ms",
+        naive_load.max() / max(load.max(), 1.0),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ CONFIGS = [
     ("3", [sys.executable, "-m", "benchmarks.config3_alltoall512"]),
     ("4", [sys.executable, "bench.py"]),
     ("5", [sys.executable, "-m", "benchmarks.config5_dragonfly"]),
+    ("6", [sys.executable, "-m", "benchmarks.config6_fattree2048"]),
 ]
 
 

@@ -42,14 +42,34 @@ except Exception:  # pragma: no cover
 
 INF = jnp.inf
 
-#: VMEM is ~16 MB/core: the [V, V] f32 adjacency plus two [B, V] strips
-#: and the output must fit. V=1024, B=256: 4 MB + 3 x 1 MB — comfortable.
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+#: Scoped VMEM is 16 MB/core: the [V, V] bf16 adjacency plus the strip
+#: working set must fit. Two tricks push the ceiling to V=2048 (fat-tree
+#: k=32 padded):
+#: - the adjacency is 0/1, so bf16 is exact (every MXU product is 0 or 1
+#:   and accumulation is f32) — half the bytes of an f32 copy;
+#: - the kernel never loads the whole adjacency as a *value*: the matmul
+#:   is column-tiled, reading [V, CBLK] slices of the VMEM-resident
+#:   input ref per step. ``adj_ref[:]`` would materialize an extra
+#:   [V, V] copy on the Mosaic stack (measured: +8 MB at V=2048, an
+#:   OOM); the constant-index-map input window itself is single-buffered.
+#: The per-program strip footprint is ~8 [B, V] f32 equivalents
+#: (carries, double-buffered output, masks, iotas), budgeted against a
+#: 15 MB cap (1 MB headroom under the hard 16 MB limit).
+_VMEM_BUDGET_BYTES = 15 * 1024 * 1024
+_STRIPS = 8
+
+
+def _fits(v: int, b: int) -> bool:
+    return v * v * 2 + _STRIPS * b * v * 4 <= _VMEM_BUDGET_BYTES
+
+
+from sdnmpi_tpu.kernels.tiling import col_block  # noqa: E402  (shared ladder)
 
 
 def pallas_supported(v: int, platform: str | None = None) -> bool:
     """Whether the fused kernel applies: TPU platform, lane-aligned V,
-    and the VMEM working set fits."""
+    and the VMEM working set fits (V <= 2048 under the bf16 adjacency;
+    beyond that callers get the XLA while_loop fallback)."""
     if not _HAS_PLTPU:
         return False
     if platform is None:
@@ -58,38 +78,46 @@ def pallas_supported(v: int, platform: str | None = None) -> bool:
         return False
     if v % 128 != 0:
         return False
-    # adjacency + ~3 strips of the smallest block size
-    return v * v * 4 + 3 * 128 * v * 4 <= _VMEM_BUDGET_BYTES
+    return _fits(v, 64)
 
 
 def _pick_block(v: int) -> int:
-    """Largest row-strip (128-multiple, dividing V) that fits the budget."""
-    best = 128
-    for b in (512, 384, 256, 128):
-        if v % b == 0 and v * v * 4 + 3 * b * v * 4 <= _VMEM_BUDGET_BYTES:
+    """Largest row-strip (dividing V) whose working set fits the budget."""
+    best = 64
+    for b in (512, 384, 256, 128, 64):
+        if v % b == 0 and _fits(v, b):
             best = b
             break
     return best
 
 
 def _bfs_kernel(adj_ref, dist_ref, *, levels: int, block: int):
-    """One grid program: full BFS for ``block`` source rows, on-chip."""
+    """One grid program: full BFS for ``block`` source rows, on-chip.
+
+    ``adj_ref`` holds the [V, V] bf16 0/1 adjacency (exact: every MXU
+    product is 0 or 1, accumulation is f32). The frontier matmul reads
+    it in [V, CBLK] column slices — never as one full value, which
+    would cost a second [V, V] VMEM allocation on the stack."""
     i = pl.program_id(0)
     v = adj_ref.shape[0]
+    cblk = col_block(v)
     # source ids of this strip -> one-hot initial frontier (2D iota only)
     row = jax.lax.broadcasted_iota(jnp.int32, (block, v), 0) + i * block
     col = jax.lax.broadcasted_iota(jnp.int32, (block, v), 1)
     eye = (row == col).astype(jnp.float32)
     dist0 = jnp.where(eye > 0, 0.0, INF)
-    adj = adj_ref[:]
 
     def body(level, carry):
         reached, dist = carry
-        grown = jnp.minimum(
-            jnp.dot(reached, adj, preferred_element_type=jnp.float32)
-            + reached,
-            1.0,
-        )
+        r16 = reached.astype(jnp.bfloat16)
+        parts = [
+            jnp.dot(
+                r16, adj_ref[:, c * cblk:(c + 1) * cblk],
+                preferred_element_type=jnp.float32,
+            )
+            for c in range(v // cblk)
+        ]
+        grown = jnp.minimum(jnp.concatenate(parts, axis=1) + reached, 1.0)
         newly = (grown > 0.0) & jnp.isinf(dist)
         dist = jnp.where(newly, level.astype(jnp.float32), dist)
         return grown, dist
@@ -112,7 +140,7 @@ def bfs_distances_pallas(
     """
     v = adj.shape[0]
     block = _pick_block(v)
-    a = (adj > 0).astype(jnp.float32)
+    a = (adj > 0).astype(jnp.bfloat16)
     kernel = functools.partial(_bfs_kernel, levels=levels, block=block)
     in_spec = pl.BlockSpec((v, v), lambda i: (0, 0))
     out_spec = pl.BlockSpec((block, v), lambda i: (i, 0))

@@ -37,15 +37,40 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-#: flows per grid program: lane-aligned, and [B, V] bf16 temporaries
-#: (~6 of them at V=1024) plus the [V, V] bf16 log-weights fit VMEM
-_BLOCK = 256
+from sdnmpi_tpu.kernels.tiling import col_block
+
+#: flows per grid program: picked per V so the [V, V] bf16 log-weights
+#: plus ~8 [B, V] bf16/f32 temporaries fit a conservative block-picking
+#: budget — 256 through V=1024, shrinking to 64 at the V=2048 ceiling
+#: (fat-tree k=32 padded). The go/no-go gate then checks the full
+#: working set (including the flow-batch-sized full-array blocks)
+#: against the hard 16 MB scoped-VMEM limit minus headroom; config 6
+#: (V=2048, 261k flows, ~15.1 MB modeled) compiles on real Mosaic.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+_VMEM_HARD_BYTES = 16 * 1024 * 1024
+_VMEM_HEADROOM = 512 * 1024
 _UNREACH = 16384.0
 _NO_LINK = -1e3  # candidates must exceed this (log-weight floor marker)
 
 
-def sampler_supported(v: int, hops: int, platform: str | None = None) -> bool:
-    """TPU platform, lane-aligned V, packable hop count, VMEM fit."""
+def _pick_block(v: int) -> int:
+    """Largest flow strip whose working set fits the VMEM budget."""
+    for b in (256, 128, 64):
+        if 2 * v * v + 8 * b * v * 4 <= _VMEM_BUDGET_BYTES:
+            return b
+    return 64
+
+
+def sampler_supported(
+    v: int, hops: int, n_flows: int = 0, platform: str | None = None
+) -> bool:
+    """TPU platform, lane-aligned V, packable hop count, VMEM fit.
+
+    ``n_flows`` sizes the three full-array VMEM blocks the kernel rides
+    (src, dst, packed output — see ``_sampler_kernel``); they scale with
+    the flow batch, not V, so a huge batch at a large V must fall back
+    to the XLA sampler even when the [V, V] working set alone fits.
+    """
     if not _HAS_PLTPU:
         return False
     if platform is None:
@@ -54,8 +79,14 @@ def sampler_supported(v: int, hops: int, platform: str | None = None) -> bool:
         return False
     if v % 128 != 0 or not (1 <= hops <= 4):
         return False
-    # lw [V, V] bf16 + ~8 strips of [B, V] bf16/f32
-    return 2 * v * v + 8 * _BLOCK * v * 4 <= 12 * 1024 * 1024
+    block = _pick_block(v)
+    f_pad = ((n_flows + block - 1) // block) * block
+    # lw [V, V] bf16 + ~8 strips of [B, V] bf16/f32 at the chosen block
+    # + the three [F_pad] int32 full-array blocks, against the hard limit
+    return (
+        2 * v * v + 8 * block * v * 4 + 3 * f_pad * 4
+        <= _VMEM_HARD_BYTES - _VMEM_HEADROOM
+    )
 
 
 def _hash_u32(x):
@@ -78,7 +109,7 @@ def _sampler_kernel(lw_ref, d2t_ref, src_ref, dst_ref, out_ref, *,
     (1, block) strip violates the TPU (8, 128) block-tiling rule."""
     i = pl.program_id(0)
     v = lw_ref.shape[1]
-    lw = lw_ref[:]  # [V, V] bf16 log-weights, -1e4 = no link
+    cblk = col_block(v)
     d2t = d2t_ref[:].astype(jnp.float32)  # [B, V] distance-to-own-dst
     src = src_ref[pl.ds(i, 1), :].reshape(block, 1)  # [B, 1] int32
     dst = dst_ref[pl.ds(i, 1), :].reshape(block, 1)
@@ -100,9 +131,17 @@ def _sampler_kernel(lw_ref, d2t_ref, src_ref, dst_ref, out_ref, *,
         node, packed = carry
         moving = (node >= 0) & (node != dst)  # [B, 1]
         oh = (iota_v == jnp.maximum(node, 0)).astype(jnp.bfloat16)
-        lwrow = jnp.dot(
-            oh, lw, preferred_element_type=jnp.float32
-        )  # [B, V] log w out of node (MXU)
+        # [B, V] log w out of node (MXU), reading lw in column slices
+        lwrow = jnp.concatenate(
+            [
+                jnp.dot(
+                    oh, lw_ref[:, c * cblk:(c + 1) * cblk],
+                    preferred_element_type=jnp.float32,
+                )
+                for c in range(v // cblk)
+            ],
+            axis=1,
+        )
         arow = lwrow > _NO_LINK
         dcur = jnp.max(
             jnp.where(iota_v == jnp.maximum(node, 0), d2t, -1.0),
@@ -163,7 +202,7 @@ def sample_slots_pallas(
     """
     v = weights.shape[0]
     f = src.shape[0]
-    block = _BLOCK
+    block = _pick_block(v)
     f_pad = ((f + block - 1) // block) * block
     pad = f_pad - f
 

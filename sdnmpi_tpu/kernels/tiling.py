@@ -1,0 +1,18 @@
+"""Shared VMEM tiling policy for the fused TPU kernels.
+
+Both kernels (bfs.py, sampler.py) read their [V, V] matrix operand in
+column slices — never as one full value, which would cost a second
+[V, V] allocation on the Mosaic stack (measured: +8 MB at V=2048, a
+scoped-VMEM OOM). The tile ladder lives here so the two kernels cannot
+drift apart.
+"""
+
+from __future__ import annotations
+
+
+def col_block(v: int) -> int:
+    """Widest column tile (<= 512, dividing V) for the sliced matmul."""
+    for c in (512, 256, 128):
+        if v % c == 0:
+            return c
+    return v

@@ -452,7 +452,7 @@ def route_collective(
     from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
 
     hops = sampled_hops(max_len)
-    if sampler_supported(v, hops):
+    if sampler_supported(v, hops, n_flows=src.shape[0]):
         # fused VMEM-resident sampler: all hops on-chip per flow strip
         slots = sample_slots_pallas(weights, dist, src, dst, hops, salt=salt)
     else:

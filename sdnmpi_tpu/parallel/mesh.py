@@ -301,13 +301,6 @@ def route_collective_sharded(
     whole-collective request of sdnmpi/topology.py:138-142 at the scale
     axis of SURVEY §5.
     """
-    from sdnmpi_tpu.oracle.dag import (
-        congestion_weights,
-        propagate_levels,
-        sample_paths_dense,
-        sampled_hops,
-    )
-
     v = adj.shape[0]
     f = src.shape[0]
     n_shards = mesh.shape["flow"] * mesh.shape["v"]
@@ -317,10 +310,35 @@ def route_collective_sharded(
         raise ValueError(f"flow count {f} must divide by {n_shards} shards")
     have_dist = dist is not None
     dist_arg = dist if have_dist else jnp.zeros_like(adj, dtype=jnp.float32)
+    step = _dag_step(mesh, levels, rounds, max_len, salt, have_dist)
+    return step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg)
+
+
+@functools.lru_cache(maxsize=None)
+def _dag_step(
+    mesh: Mesh, levels: int, rounds: int, max_len: int, salt: int,
+    have_dist: bool,
+):
+    """Build (and cache) the jitted sharded DAG step for one config.
+
+    jax.jit caches per function object, so the closure must be reused
+    across calls — a steady-state caller routing one collective per
+    second would otherwise retrace and recompile the whole multi-device
+    program every time. Keyed on the mesh (hashable) and the static
+    routing parameters; array shapes are handled by jit's own cache.
+    """
+    from sdnmpi_tpu.oracle.dag import (
+        congestion_weights,
+        propagate_levels,
+        sample_paths_dense,
+        sampled_hops,
+    )
+
     hops = sampled_hops(max_len)
 
     @jax.jit
     def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in):
+        v = adj.shape[0]
         base = (
             jnp.zeros((v, v), jnp.float32)
             .at[link_src, link_dst]
@@ -370,7 +388,7 @@ def route_collective_sharded(
         slots, maxc = inner(adj, d, d.T, base, traffic, src, dst)
         return slots, maxc[0, 0]
 
-    return step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg)
+    return step
 
 
 def multichip_route_step(

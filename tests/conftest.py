@@ -2,6 +2,9 @@
 
 Tests run on the JAX CPU backend with 8 virtual devices so that the
 multi-chip sharding paths (parallel/) are exercised without TPU hardware.
+Set ``SDNMPI_TEST_TPU=1`` to keep the real backend instead — only
+tests/test_kernels_tpu.py does anything on it (everything else is
+written for the virtual CPU mesh and is skipped or slow on the tunnel).
 
 This environment pins JAX_PLATFORMS=axon (a TPU tunnel) and imports jax
 during interpreter startup via sitecustomize, so setting env vars here is
@@ -20,4 +23,5 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("SDNMPI_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
