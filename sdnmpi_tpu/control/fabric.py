@@ -278,13 +278,30 @@ class SimHost:
 
 
 class Fabric:
-    """Container for the simulated network; owns discovery announcements."""
+    """Container for the simulated network; owns discovery announcements.
 
-    def __init__(self) -> None:
+    With ``wire=True`` every OpenFlow-shaped southbound exchange
+    (FlowMod, PacketOut, PortStats, packet-in) round-trips through the
+    byte-level OpenFlow 1.0 codec (protocol/ofwire.py) — the
+    controller's messages are serialized to the real wire format and
+    re-parsed before the switch acts on them, so the sim proves the
+    same bytes a physical OF 1.0 switch would receive (reference emits
+    these via Ryu, sdnmpi/router.py:49-62, monitor.py:54-60,
+    process.py:61-79). ``flow_block_set`` is the one exception: the
+    array-native collective install is this framework's extension with
+    no OF 1.0 equivalent (see protocol/ofwire.py docstring)."""
+
+    def __init__(self, wire: bool = False) -> None:
         self.switches: dict[int, SimSwitch] = {}
         self.hosts: dict[str, SimHost] = {}
         self.links: list[tuple[int, int, int, int]] = []  # (a, pa, b, pb)
         self.bus = None  # set by connect()
+        self.wire = wire
+        self._xid = 0
+
+    def _next_xid(self) -> int:
+        self._xid += 1
+        return self._xid
 
     # -- construction -----------------------------------------------------
 
@@ -399,6 +416,12 @@ class Fabric:
         if sw is None:  # datapath died between event and flow_mod
             log.debug("flow_mod to unknown dpid %s dropped", dpid)
             return
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            mod = ofwire.decode_flow_mod(
+                ofwire.encode_flow_mod(mod, xid=self._next_xid())
+            )
         sw.flow_mod(mod)
 
     def flow_block_set(self, block: of.FlowBlockSet) -> None:
@@ -443,6 +466,12 @@ class Fabric:
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
         sw = self.switches[dpid]
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            out = ofwire.decode_packet_out(
+                ofwire.encode_packet_out(out, xid=self._next_xid())
+            )
         pkt = out.data
         if out.buffer_id != of.OFP_NO_BUFFER:
             # use the switch-side buffered frame (reference:
@@ -457,7 +486,14 @@ class Fabric:
         sw.apply_actions(out.actions, pkt, out.in_port, hops=0)
 
     def port_stats(self, dpid: int) -> list[of.PortStatsEntry]:
-        return self.switches[dpid].port_stats()
+        entries = self.switches[dpid].port_stats()
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            entries = ofwire.decode_port_stats_reply(
+                ofwire.encode_port_stats_reply(entries, xid=self._next_xid())
+            )
+        return entries
 
     def connected_dpids(self) -> list[int]:
         return sorted(self.switches)
@@ -472,6 +508,14 @@ class Fabric:
         buffer_id: int = of.OFP_NO_BUFFER,
     ) -> None:
         if self.bus is not None:
+            if self.wire:
+                from sdnmpi_tpu.protocol import ofwire
+
+                pkt, in_port, buffer_id, _reason = ofwire.decode_packet_in(
+                    ofwire.encode_packet_in(
+                        pkt, in_port, buffer_id, xid=self._next_xid()
+                    )
+                )
             self.bus.publish(EventPacketIn(dpid, in_port, pkt, buffer_id))
 
     def transmit(self, peer: tuple, pkt: of.Packet, hops: int) -> None:

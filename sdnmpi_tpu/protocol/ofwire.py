@@ -1,0 +1,492 @@
+"""OpenFlow 1.0 byte-level wire codec.
+
+The reference emits real OF 1.0 bytes to real switches through Ryu's
+serializers (`OFPFlowMod`/`OFPPacketOut` at reference:
+sdnmpi/router.py:49-62,106-123, `OFPPortStatsRequest` at
+sdnmpi/monitor.py:54-60, the UDP:61000 flow install at
+sdnmpi/process.py:61-79). This module is that capability without Ryu: a
+dependency-free serialize/parse for exactly the message subset the apps
+use —
+
+    OFPT_HELLO / OFPT_ECHO_REQUEST / OFPT_ECHO_REPLY   (channel liveness)
+    OFPT_PACKET_IN                                      (switch -> ctrl)
+    OFPT_PACKET_OUT                                     (ctrl -> switch)
+    OFPT_FLOW_MOD                                       (ctrl -> switch)
+    OFPT_FLOW_REMOVED                                   (switch -> ctrl)
+    OFPT_STATS_REQUEST / OFPT_STATS_REPLY (OFPST_PORT)  (monitor loop)
+
+plus the Ethernet/IPv4/UDP framing for packet data (the reference parses
+real frames with ryu.lib.packet, reference: sdnmpi/router.py:130-133,
+process.py:84-89). Encoders take the dataclass message shapes of
+protocol/openflow.py; decoders return the same shapes, so the simulated
+fabric can round-trip every southbound exchange through real wire bytes
+(``Fabric(wire=True)``, control/fabric.py) and a real OF 1.0 switch
+could be driven by the identical encoder output.
+
+Wire layouts follow the OpenFlow 1.0.0 specification structs
+(ofp_header, ofp_match, ofp_flow_mod, ofp_action_output,
+ofp_action_dl_addr, ofp_packet_out, ofp_packet_in, ofp_stats_request/
+reply, ofp_port_stats, ofp_flow_removed); all integers big-endian.
+
+Deliberately NOT covered: ``FlowBlockSet`` (protocol/openflow.py), the
+array-native whole-collective install. It is this framework's extension
+beyond OpenFlow 1.0 — semantically equivalent to S x L x M per-member
+FlowMods (each individually encodable here) but transported as shared
+arrays precisely so a collective is O(S x L + M), not O(S x L x M),
+messages. ``Fabric(wire=True)`` therefore byte-validates the reactive
+per-packet path only; the block path is exercised semantically by
+tests/test_collective_blocks.py.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from sdnmpi_tpu.protocol import openflow as of
+
+OFP_VERSION = 0x01
+
+# message types (ofp_type)
+OFPT_HELLO = 0
+OFPT_ERROR = 1
+OFPT_ECHO_REQUEST = 2
+OFPT_ECHO_REPLY = 3
+OFPT_FEATURES_REQUEST = 5
+OFPT_FEATURES_REPLY = 6
+OFPT_PACKET_IN = 10
+OFPT_FLOW_REMOVED = 11
+OFPT_PACKET_OUT = 13
+OFPT_FLOW_MOD = 14
+OFPT_STATS_REQUEST = 16
+OFPT_STATS_REPLY = 17
+
+# ofp_flow_mod_flags
+OFPFF_SEND_FLOW_REM = 1 << 0
+
+# ofp_packet_in reason
+OFPR_NO_MATCH = 0
+OFPR_ACTION = 1
+
+# ofp_flow_removed reason
+OFPRR_IDLE_TIMEOUT = 0
+OFPRR_HARD_TIMEOUT = 1
+OFPRR_DELETE = 2
+
+# ofp_stats_types
+OFPST_PORT = 4
+
+# ofp_flow_wildcards
+OFPFW_IN_PORT = 1 << 0
+OFPFW_DL_VLAN = 1 << 1
+OFPFW_DL_SRC = 1 << 2
+OFPFW_DL_DST = 1 << 3
+OFPFW_DL_TYPE = 1 << 4
+OFPFW_NW_PROTO = 1 << 5
+OFPFW_TP_SRC = 1 << 6
+OFPFW_TP_DST = 1 << 7
+OFPFW_NW_SRC_ALL = 32 << 8
+OFPFW_NW_DST_ALL = 32 << 14
+OFPFW_DL_VLAN_PCP = 1 << 20
+OFPFW_NW_TOS = 1 << 21
+OFPFW_ALL = (1 << 22) - 1
+
+# action types
+OFPAT_OUTPUT = 0
+OFPAT_SET_DL_SRC = 4
+OFPAT_SET_DL_DST = 5
+
+_HEADER = struct.Struct("!BBHI")  # version, type, length, xid
+_MATCH = struct.Struct("!IH6s6sHBxHBB2xIIHH")  # ofp_match, 40 bytes
+_MATCH_LEN = 40
+assert _MATCH.size == _MATCH_LEN
+
+
+def _mac_bytes(mac: str) -> bytes:
+    return bytes.fromhex(mac.replace(":", ""))
+
+
+def _mac_str(b: bytes) -> str:
+    return ":".join(f"{x:02x}" for x in b)
+
+
+# -- header ----------------------------------------------------------------
+
+
+def _pack(msg_type: int, body: bytes, xid: int) -> bytes:
+    return _HEADER.pack(OFP_VERSION, msg_type, _HEADER.size + len(body), xid) + body
+
+
+def peek_header(buf: bytes) -> tuple[int, int, int]:
+    """(msg_type, total_length, xid) of the message at ``buf[0:]`` —
+    enough to frame a TCP byte stream into messages."""
+    version, msg_type, length, xid = _HEADER.unpack_from(buf)
+    if version != OFP_VERSION:
+        raise ValueError(f"unsupported OpenFlow version 0x{version:02x}")
+    return msg_type, length, xid
+
+
+# -- ethernet / IPv4 / UDP framing ----------------------------------------
+
+
+def _ip_checksum(header: bytes) -> int:
+    s = sum(struct.unpack(f"!{len(header) // 2}H", header))
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return ~s & 0xFFFF
+
+
+def encode_frame(pkt: of.Packet) -> bytes:
+    """Serialize a structured Packet to real Ethernet bytes.
+
+    Non-IP ethertypes carry ``payload`` raw after the 14-byte header.
+    UDP frames (the announcement sideband) get a minimal IPv4 + UDP
+    header so the dport the apps match on (reference:
+    sdnmpi/process.py:70,103) is real wire data.
+    """
+    eth = _mac_bytes(pkt.eth_dst) + _mac_bytes(pkt.eth_src) + struct.pack(
+        "!H", pkt.eth_type
+    )
+    if pkt.eth_type != of.ETH_TYPE_IP:
+        return eth + pkt.payload
+    # canonicalize the sim's shorthand shapes onto the wire:
+    # - udp_dst set implies UDP even when ip_proto was left None
+    #   (the apps key on udp_dst alone, e.g. the announcement dispatch,
+    #   reference: sdnmpi/process.py:103) — the decoded packet comes
+    #   back with ip_proto=17 materialized;
+    # - ip_proto None with no udp_dst maps to wire protocol 0 and back
+    #   to None, an identity round-trip for plain L2-matched IP packets.
+    proto = pkt.ip_proto
+    if proto is None:
+        proto = of.IPPROTO_UDP if pkt.udp_dst is not None else 0
+    if proto == of.IPPROTO_UDP:
+        # dport 0 is invalid in real UDP; it encodes udp_dst=None
+        l4 = struct.pack(
+            "!HHHH", 0, pkt.udp_dst or 0, 8 + len(pkt.payload), 0
+        )
+        l4 += pkt.payload
+    else:
+        l4 = pkt.payload
+    total = 20 + len(l4)
+    ip = struct.pack(
+        "!BBHHHBBH4s4s", 0x45, 0, total, 0, 0, 64, proto, 0,
+        b"\x00" * 4, b"\x00" * 4,
+    )
+    ip = ip[:10] + struct.pack("!H", _ip_checksum(ip)) + ip[12:]
+    return eth + ip + l4
+
+
+def decode_frame(data: bytes) -> of.Packet:
+    """Parse Ethernet bytes back to the structured Packet the apps use."""
+    if len(data) < 14:
+        raise ValueError("short ethernet frame")
+    eth_dst = _mac_str(data[0:6])
+    eth_src = _mac_str(data[6:12])
+    (eth_type,) = struct.unpack_from("!H", data, 12)
+    rest = data[14:]
+    if eth_type != of.ETH_TYPE_IP:
+        return of.Packet(eth_src, eth_dst, eth_type, payload=rest)
+    ihl = (rest[0] & 0x0F) * 4
+    proto = rest[9]
+    l4 = rest[ihl:]
+    if proto == of.IPPROTO_UDP and len(l4) >= 8:
+        _, dport, _, _ = struct.unpack_from("!HHHH", l4)
+        return of.Packet(
+            eth_src, eth_dst, eth_type, ip_proto=proto,
+            udp_dst=dport or None,  # dport 0 encodes udp_dst=None
+            payload=l4[8:],
+        )
+    return of.Packet(
+        eth_src, eth_dst, eth_type,
+        ip_proto=None if proto == 0 else proto,  # see encode_frame
+        payload=l4,
+    )
+
+
+# -- ofp_match -------------------------------------------------------------
+
+
+def encode_match(m: of.Match) -> bytes:
+    wildcards = (
+        OFPFW_DL_VLAN | OFPFW_TP_SRC | OFPFW_DL_VLAN_PCP | OFPFW_NW_TOS
+        | OFPFW_NW_SRC_ALL | OFPFW_NW_DST_ALL
+    )
+    if m.in_port is None:
+        wildcards |= OFPFW_IN_PORT
+    if m.dl_src is None:
+        wildcards |= OFPFW_DL_SRC
+    if m.dl_dst is None:
+        wildcards |= OFPFW_DL_DST
+    if m.dl_type is None:
+        wildcards |= OFPFW_DL_TYPE
+    if m.nw_proto is None:
+        wildcards |= OFPFW_NW_PROTO
+    if m.tp_dst is None:
+        wildcards |= OFPFW_TP_DST
+    return _MATCH.pack(
+        wildcards,
+        m.in_port or 0,
+        _mac_bytes(m.dl_src) if m.dl_src else b"\x00" * 6,
+        _mac_bytes(m.dl_dst) if m.dl_dst else b"\x00" * 6,
+        0,  # dl_vlan
+        0,  # dl_vlan_pcp
+        m.dl_type or 0,
+        0,  # nw_tos
+        m.nw_proto or 0,
+        0,  # nw_src
+        0,  # nw_dst
+        0,  # tp_src
+        m.tp_dst or 0,
+    )
+
+
+def decode_match(buf: bytes) -> of.Match:
+    (w, in_port, dl_src, dl_dst, _vlan, _pcp, dl_type, _tos, nw_proto,
+     _nw_src, _nw_dst, _tp_src, tp_dst) = _MATCH.unpack_from(buf)
+    return of.Match(
+        in_port=None if w & OFPFW_IN_PORT else in_port,
+        dl_src=None if w & OFPFW_DL_SRC else _mac_str(dl_src),
+        dl_dst=None if w & OFPFW_DL_DST else _mac_str(dl_dst),
+        dl_type=None if w & OFPFW_DL_TYPE else dl_type,
+        nw_proto=None if w & OFPFW_NW_PROTO else nw_proto,
+        tp_dst=None if w & OFPFW_TP_DST else tp_dst,
+    )
+
+
+# -- actions ---------------------------------------------------------------
+
+
+def encode_actions(actions: tuple[of.Action, ...]) -> bytes:
+    out = b""
+    for a in actions:
+        if isinstance(a, of.ActionOutput):
+            # max_len: bytes sent to the controller on output-to-controller
+            out += struct.pack("!HHHH", OFPAT_OUTPUT, 8, a.port, 0xFFFF)
+        elif isinstance(a, of.ActionSetDlDst):
+            out += struct.pack(
+                "!HH6s6x", OFPAT_SET_DL_DST, 16, _mac_bytes(a.mac)
+            )
+        else:
+            raise ValueError(f"unsupported action {a!r}")
+    return out
+
+
+def decode_actions(buf: bytes) -> tuple[of.Action, ...]:
+    actions: list[of.Action] = []
+    off = 0
+    while off < len(buf):
+        a_type, a_len = struct.unpack_from("!HH", buf, off)
+        if a_len < 8 or off + a_len > len(buf):
+            raise ValueError("malformed action")
+        if a_type == OFPAT_OUTPUT:
+            port, _max_len = struct.unpack_from("!HH", buf, off + 4)
+            actions.append(of.ActionOutput(port))
+        elif a_type == OFPAT_SET_DL_DST:
+            (mac,) = struct.unpack_from("!6s", buf, off + 4)
+            actions.append(of.ActionSetDlDst(_mac_str(mac)))
+        else:
+            raise ValueError(f"unsupported action type {a_type}")
+        off += a_len
+    return tuple(actions)
+
+
+# -- messages --------------------------------------------------------------
+
+
+def encode_hello(xid: int = 0) -> bytes:
+    return _pack(OFPT_HELLO, b"", xid)
+
+
+def encode_echo_request(data: bytes = b"", xid: int = 0) -> bytes:
+    return _pack(OFPT_ECHO_REQUEST, data, xid)
+
+
+def encode_echo_reply(data: bytes = b"", xid: int = 0) -> bytes:
+    return _pack(OFPT_ECHO_REPLY, data, xid)
+
+
+def encode_flow_mod(
+    mod: of.FlowMod,
+    xid: int = 0,
+    buffer_id: int = of.OFP_NO_BUFFER,
+    out_port: int = of.OFPP_NONE,
+    flags: int = OFPFF_SEND_FLOW_REM,
+) -> bytes:
+    """ofp_flow_mod — the reference's _add_flow body with
+    OFPFF_SEND_FLOW_REM set (reference: sdnmpi/router.py:49-62)."""
+    body = encode_match(mod.match) + struct.pack(
+        "!QHHHHIHH",
+        mod.cookie,
+        mod.command,
+        mod.idle_timeout,
+        mod.hard_timeout,
+        mod.priority,
+        buffer_id,
+        out_port,
+        flags,
+    ) + encode_actions(mod.actions)
+    return _pack(OFPT_FLOW_MOD, body, xid)
+
+
+def decode_flow_mod(buf: bytes) -> of.FlowMod:
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_FLOW_MOD:
+        raise ValueError(f"not a flow_mod (type {msg_type})")
+    body = buf[_HEADER.size:length]
+    match = decode_match(body)
+    (cookie, command, idle_t, hard_t, priority, _buf_id, _out_port,
+     _flags) = struct.unpack_from("!QHHHHIHH", body, _MATCH_LEN)
+    actions = decode_actions(body[_MATCH_LEN + 24:])
+    return of.FlowMod(
+        match=match, actions=actions, priority=priority, command=command,
+        idle_timeout=idle_t, hard_timeout=hard_t, cookie=cookie,
+    )
+
+
+def encode_packet_out(out: of.PacketOut, xid: int = 0) -> bytes:
+    """ofp_packet_out (reference: sdnmpi/router.py:106-123 — reuses the
+    switch buffer when ``buffer_id`` is set, sends data bytes otherwise)."""
+    actions = encode_actions(out.actions)
+    data = b"" if out.buffer_id != of.OFP_NO_BUFFER else encode_frame(out.data)
+    body = struct.pack(
+        "!IHH", out.buffer_id, out.in_port, len(actions)
+    ) + actions + data
+    return _pack(OFPT_PACKET_OUT, body, xid)
+
+
+def decode_packet_out(buf: bytes) -> of.PacketOut:
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_PACKET_OUT:
+        raise ValueError(f"not a packet_out (type {msg_type})")
+    body = buf[_HEADER.size:length]
+    buffer_id, in_port, actions_len = struct.unpack_from("!IHH", body)
+    actions = decode_actions(body[8:8 + actions_len])
+    data = body[8 + actions_len:]
+    pkt = (
+        decode_frame(data)
+        if data
+        else of.Packet("00:00:00:00:00:00", "00:00:00:00:00:00")
+    )
+    return of.PacketOut(
+        data=pkt, actions=actions, in_port=in_port, buffer_id=buffer_id
+    )
+
+
+def encode_packet_in(
+    pkt: of.Packet,
+    in_port: int,
+    buffer_id: int = of.OFP_NO_BUFFER,
+    reason: int = OFPR_NO_MATCH,
+    xid: int = 0,
+) -> bytes:
+    """ofp_packet_in — the table-miss upcall every app handler consumes
+    (reference: sdnmpi/router.py:125-133, topology.py:110-131)."""
+    frame = encode_frame(pkt)
+    body = struct.pack(
+        "!IHHBx", buffer_id, len(frame), in_port, reason
+    ) + frame
+    return _pack(OFPT_PACKET_IN, body, xid)
+
+
+def decode_packet_in(buf: bytes) -> tuple[of.Packet, int, int, int]:
+    """Returns (packet, in_port, buffer_id, reason)."""
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_PACKET_IN:
+        raise ValueError(f"not a packet_in (type {msg_type})")
+    body = buf[_HEADER.size:length]
+    buffer_id, _total_len, in_port, reason = struct.unpack_from("!IHHBx", body)
+    return decode_frame(body[10:]), in_port, buffer_id, reason
+
+
+def encode_flow_removed(
+    match: of.Match,
+    priority: int,
+    reason: int,
+    cookie: int = 0,
+    duration_sec: int = 0,
+    idle_timeout: int = 0,
+    packet_count: int = 0,
+    byte_count: int = 0,
+    xid: int = 0,
+) -> bytes:
+    """ofp_flow_removed — the reply to OFPFF_SEND_FLOW_REM that the
+    reference requests but never handles (reference: sdnmpi/router.py:61,
+    SURVEY §2 defect); this framework's Router consumes it."""
+    body = encode_match(match) + struct.pack(
+        "!QHBxIIH2xQQ",
+        cookie, priority, reason, duration_sec, 0, idle_timeout,
+        packet_count, byte_count,
+    )
+    return _pack(OFPT_FLOW_REMOVED, body, xid)
+
+
+def decode_flow_removed(buf: bytes) -> dict:
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_FLOW_REMOVED:
+        raise ValueError(f"not a flow_removed (type {msg_type})")
+    body = buf[_HEADER.size:length]
+    match = decode_match(body)
+    (cookie, priority, reason, dur_s, _dur_ns, idle_t, pkts,
+     bts) = struct.unpack_from("!QHBxIIH2xQQ", body, _MATCH_LEN)
+    return {
+        "match": match, "cookie": cookie, "priority": priority,
+        "reason": reason, "duration_sec": dur_s, "idle_timeout": idle_t,
+        "packet_count": pkts, "byte_count": bts,
+    }
+
+
+def encode_port_stats_request(
+    port_no: int = of.OFPP_NONE, xid: int = 0
+) -> bytes:
+    """ofp_stats_request(OFPST_PORT) — the Monitor's 1 Hz poll
+    (reference: sdnmpi/monitor.py:54-60; OFPP_NONE = all ports)."""
+    body = struct.pack("!HH", OFPST_PORT, 0) + struct.pack("!H6x", port_no)
+    return _pack(OFPT_STATS_REQUEST, body, xid)
+
+
+def decode_port_stats_request(buf: bytes) -> int:
+    """Returns the requested port_no (OFPP_NONE = all)."""
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_STATS_REQUEST:
+        raise ValueError(f"not a stats_request (type {msg_type})")
+    stats_type, _flags = struct.unpack_from("!HH", buf, _HEADER.size)
+    if stats_type != OFPST_PORT:
+        raise ValueError(f"unsupported stats type {stats_type}")
+    (port_no,) = struct.unpack_from("!H", buf, _HEADER.size + 4)
+    return port_no
+
+
+_PORT_STATS = struct.Struct("!H6xQQQQQQQQQQQQ")  # ofp_port_stats, 104 bytes
+
+
+def encode_port_stats_reply(
+    entries: list[of.PortStatsEntry], xid: int = 0
+) -> bytes:
+    """ofp_stats_reply(OFPST_PORT) with one ofp_port_stats per port; the
+    counters the Monitor differentiates into pps/bps
+    (reference: sdnmpi/monitor.py:62-94). Unmodeled error/drop counters
+    are zero."""
+    body = struct.pack("!HH", OFPST_PORT, 0)
+    for e in entries:
+        body += _PORT_STATS.pack(
+            e.port_no, e.rx_packets, e.tx_packets, e.rx_bytes, e.tx_bytes,
+            0, 0, 0, 0, 0, 0, 0, 0,
+        )
+    return _pack(OFPT_STATS_REPLY, body, xid)
+
+
+def decode_port_stats_reply(buf: bytes) -> list[of.PortStatsEntry]:
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_STATS_REPLY:
+        raise ValueError(f"not a stats_reply (type {msg_type})")
+    stats_type, _flags = struct.unpack_from("!HH", buf, _HEADER.size)
+    if stats_type != OFPST_PORT:
+        raise ValueError(f"unsupported stats type {stats_type}")
+    entries = []
+    off = _HEADER.size + 4
+    while off + _PORT_STATS.size <= length:
+        (port_no, rx_p, tx_p, rx_b, tx_b, *_rest) = _PORT_STATS.unpack_from(
+            buf, off
+        )
+        entries.append(of.PortStatsEntry(port_no, rx_p, rx_b, tx_p, tx_b))
+        off += _PORT_STATS.size
+    return entries

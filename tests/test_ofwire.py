@@ -1,0 +1,277 @@
+"""OpenFlow 1.0 wire codec: known byte vectors, round-trips, and the
+control plane end-to-end over real bytes (``Fabric(wire=True)``).
+
+The vectors are hand-assembled from the OpenFlow 1.0.0 specification
+structs; they pin the exact bytes a physical OF 1.0 switch would
+receive, matching what the reference emits through Ryu
+(reference: sdnmpi/router.py:49-62, monitor.py:54-60).
+"""
+
+import pytest
+
+from sdnmpi_tpu.protocol import ofwire
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+
+MAC1 = "02:00:00:00:00:01"
+MAC2 = "02:00:00:00:00:02"
+
+
+class TestKnownVectors:
+    def test_hello(self):
+        assert ofwire.encode_hello(xid=1) == bytes.fromhex("0100000800000001")
+
+    def test_echo(self):
+        assert ofwire.encode_echo_request(b"ab", xid=2) == bytes.fromhex(
+            "010200" "0a" "00000002" "6162"
+        )
+        assert ofwire.encode_echo_reply(b"ab", xid=2) == bytes.fromhex(
+            "010300" "0a" "00000002" "6162"
+        )
+
+    def test_port_stats_request(self):
+        # header(8) + ofp_stats_request(4: type=OFPST_PORT, flags=0)
+        # + ofp_port_stats_request(8: port=OFPP_NONE, 6 pad)
+        assert ofwire.encode_port_stats_request(xid=3) == bytes.fromhex(
+            "01100014" "00000003" "0004" "0000" "ffff" "000000000000"
+        )
+
+    def test_flow_mod_exact_l2_match(self):
+        """The reference's routing flow: exact (dl_src, dl_dst) match,
+        one output action (reference: sdnmpi/router.py:49-62)."""
+        mod = of.FlowMod(
+            match=of.Match(dl_src=MAC1, dl_dst=MAC2),
+            actions=(of.ActionOutput(2),),
+            priority=0x8000,
+        )
+        got = ofwire.encode_flow_mod(mod, xid=4)
+        expected = bytes.fromhex(
+            "010e0050" "00000004"          # header: v1, FLOW_MOD, len 80
+            "003820f3"                      # wildcards: all but dl_src/dl_dst
+            "0000"                          # in_port
+            "020000000001" "020000000002"   # dl_src, dl_dst
+            "0000" "00" "00"                # dl_vlan, pcp, pad
+            "0000" "00" "00" "0000"         # dl_type, tos, proto, pad
+            "00000000" "00000000"           # nw_src, nw_dst
+            "0000" "0000"                   # tp_src, tp_dst
+            "0000000000000000"              # cookie
+            "0000" "0000" "0000"            # command=ADD, idle, hard
+            "8000"                          # priority
+            "ffffffff" "ffff" "0001"        # buffer, out_port, SEND_FLOW_REM
+            "00000008" "0002" "ffff"        # action: OUTPUT(2), max_len
+        )
+        assert got == expected
+        assert ofwire.decode_flow_mod(got) == mod
+
+    def test_flow_mod_announcement_flow(self):
+        """The ProcessManager's UDP:61000 -> controller bootstrap flow
+        (reference: sdnmpi/process.py:61-79)."""
+        mod = of.FlowMod(
+            match=of.Match(
+                dl_type=of.ETH_TYPE_IP, nw_proto=of.IPPROTO_UDP, tp_dst=61000
+            ),
+            actions=(of.ActionOutput(of.OFPP_CONTROLLER),),
+            priority=0xFFFF,
+        )
+        got = ofwire.encode_flow_mod(mod, xid=5)
+        # wildcards: everything except dl_type/nw_proto/tp_dst
+        assert got[8:12] == bytes.fromhex("0038204f")
+        m = ofwire.decode_flow_mod(got)
+        assert m == mod
+
+
+class TestRoundTrips:
+    @pytest.mark.parametrize(
+        "match",
+        [
+            of.Match(),
+            of.Match(in_port=3),
+            of.Match(dl_dst="ff:ff:ff:ff:ff:ff"),
+            of.Match(dl_src=MAC1, dl_dst=MAC2),
+            of.Match(dl_type=0x0800, nw_proto=17, tp_dst=61000),
+        ],
+    )
+    def test_match(self, match):
+        assert ofwire.decode_match(ofwire.encode_match(match)) == match
+
+    @pytest.mark.parametrize(
+        "actions",
+        [
+            (),
+            (of.ActionOutput(7),),
+            (of.ActionSetDlDst(MAC2), of.ActionOutput(1)),
+            tuple(of.ActionOutput(p) for p in range(1, 9)),
+        ],
+    )
+    def test_actions(self, actions):
+        assert ofwire.decode_actions(ofwire.encode_actions(actions)) == actions
+
+    @pytest.mark.parametrize(
+        "pkt",
+        [
+            of.Packet(MAC1, MAC2, eth_type=0x88CC, payload=b"lldp-ish"),
+            of.Packet(MAC1, MAC2),  # IP, no proto (sim shape)
+            of.Packet(MAC1, "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+                      udp_dst=61000, payload=b"\x00\x00\x00\x00\x05\x00\x00\x00"),
+            of.Packet(MAC1, MAC2, ip_proto=6, payload=b"tcp-ish"),
+        ],
+    )
+    def test_frame(self, pkt):
+        assert ofwire.decode_frame(ofwire.encode_frame(pkt)) == pkt
+
+    def test_udp_frame_without_dport_round_trips(self):
+        # encode and decode must agree on when a UDP header exists:
+        # proto 17 always carries one; dport 0 encodes udp_dst=None
+        pkt = of.Packet(MAC1, MAC2, ip_proto=of.IPPROTO_UDP, udp_dst=None,
+                        payload=b"ABCDEFGHIJ")
+        assert ofwire.decode_frame(ofwire.encode_frame(pkt)) == pkt
+
+    def test_udp_shorthand_canonicalized(self):
+        # udp_dst set with ip_proto left None (sim shorthand) comes back
+        # with ip_proto=17 materialized and udp_dst intact — the field
+        # the apps dispatch on survives the wire
+        pkt = of.Packet(MAC1, MAC2, udp_dst=61000, payload=b"x")
+        back = ofwire.decode_frame(ofwire.encode_frame(pkt))
+        assert back.udp_dst == 61000
+        assert back.ip_proto == of.IPPROTO_UDP
+        assert back.payload == b"x"
+
+    def test_udp_frame_has_real_headers(self):
+        pkt = of.Packet(MAC1, MAC2, ip_proto=of.IPPROTO_UDP, udp_dst=61000,
+                        payload=b"xy")
+        frame = ofwire.encode_frame(pkt)
+        assert frame[12:14] == b"\x08\x00"       # ethertype IPv4
+        assert frame[14] == 0x45                 # IPv4, IHL 5
+        assert frame[23] == 17                   # proto UDP
+        assert frame[36:38] == (61000).to_bytes(2, "big")  # dport
+        assert frame[-2:] == b"xy"
+
+    def test_packet_out_with_data(self):
+        out = of.PacketOut(
+            data=of.Packet(MAC1, MAC2, payload=b"p"),
+            actions=(of.ActionOutput(4),),
+            in_port=1,
+        )
+        assert ofwire.decode_packet_out(ofwire.encode_packet_out(out)) == out
+
+    def test_packet_out_buffered_omits_data(self):
+        out = of.PacketOut(
+            data=of.Packet(MAC1, MAC2), actions=(of.ActionOutput(4),),
+            in_port=1, buffer_id=77,
+        )
+        wire = ofwire.encode_packet_out(out)
+        back = ofwire.decode_packet_out(wire)
+        assert back.buffer_id == 77 and back.actions == out.actions
+        # data not on the wire (the switch uses its buffer), so length is
+        # header + 8 body + one action
+        assert len(wire) == 8 + 8 + 8
+
+    def test_packet_in(self):
+        pkt = of.Packet(MAC1, MAC2, ip_proto=17, udp_dst=61000, payload=b"a")
+        wire = ofwire.encode_packet_in(pkt, in_port=5, buffer_id=9)
+        back, in_port, buffer_id, reason = ofwire.decode_packet_in(wire)
+        assert (back, in_port, buffer_id, reason) == (pkt, 5, 9,
+                                                      ofwire.OFPR_NO_MATCH)
+
+    def test_flow_removed(self):
+        match = of.Match(dl_src=MAC1, dl_dst=MAC2)
+        wire = ofwire.encode_flow_removed(
+            match, priority=0x8000, reason=ofwire.OFPRR_IDLE_TIMEOUT,
+            duration_sec=12, idle_timeout=5, packet_count=100, byte_count=6400,
+        )
+        rec = ofwire.decode_flow_removed(wire)
+        assert rec["match"] == match
+        assert rec["reason"] == ofwire.OFPRR_IDLE_TIMEOUT
+        assert rec["priority"] == 0x8000
+        assert rec["packet_count"] == 100 and rec["byte_count"] == 6400
+
+    def test_port_stats_reply(self):
+        entries = [
+            of.PortStatsEntry(1, 10, 1000, 20, 2000),
+            of.PortStatsEntry(2, 0, 0, 5, 320),
+        ]
+        back = ofwire.decode_port_stats_reply(
+            ofwire.encode_port_stats_reply(entries)
+        )
+        assert back == entries
+
+    def test_stream_framing(self):
+        """peek_header frames a concatenated byte stream, as on a real
+        OF TCP channel."""
+        msgs = [
+            ofwire.encode_hello(xid=1),
+            ofwire.encode_port_stats_request(xid=2),
+            ofwire.encode_echo_request(b"ping", xid=3),
+        ]
+        stream = b"".join(msgs)
+        seen = []
+        off = 0
+        while off < len(stream):
+            msg_type, length, xid = ofwire.peek_header(stream[off:])
+            seen.append((msg_type, xid))
+            off += length
+        assert seen == [(ofwire.OFPT_HELLO, 1), (ofwire.OFPT_STATS_REQUEST, 2),
+                        (ofwire.OFPT_ECHO_REQUEST, 3)]
+
+    def test_version_check(self):
+        with pytest.raises(ValueError):
+            ofwire.peek_header(b"\x04\x00\x00\x08\x00\x00\x00\x00")  # OF 1.3
+
+
+class TestWireFabric:
+    """The full control plane over real bytes: every FlowMod, PacketOut,
+    PortStats, and packet-in crosses the OF 1.0 codec."""
+
+    def _stack(self):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+        from tests.test_control import MAC
+
+        fabric = Fabric(wire=True)
+        for d in (1, 2, 3, 4):
+            fabric.add_switch(d)
+        fabric.add_link(1, 2, 2, 2)
+        fabric.add_link(1, 3, 3, 3)
+        fabric.add_link(2, 3, 4, 2)
+        fabric.add_link(3, 2, 4, 3)
+        for d in (1, 2, 3, 4):
+            fabric.add_host(MAC[d], d, 1)
+        controller = Controller(fabric, Config(oracle_backend="py"))
+        controller.attach()
+        return fabric, controller, MAC
+
+    def test_routing_over_wire(self):
+        fabric, controller, MAC = self._stack()
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        assert len(fabric.hosts[MAC[4]].received) == 1
+        assert controller.router.fdb.exists(1, MAC[1], MAC[4])
+        # second packet forwards in-fabric (flows installed from wire bytes)
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        assert len(fabric.hosts[MAC[4]].received) == 2
+
+    def test_announcement_over_wire(self):
+        fabric, controller, MAC = self._stack()
+        fabric.hosts[MAC[2]].send(of.Packet(
+            MAC[2], "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, 7).encode(),
+        ))
+        assert controller.process_manager.rankdb.get_mac(7) == MAC[2]
+
+    def test_monitor_over_wire(self):
+        fabric, controller, MAC = self._stack()
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        controller.monitor.poll(now=0.0)
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        controller.monitor.poll(now=1.0)
+        # deltas flowed through encode/decode of the stats reply into the
+        # TopologyManager's utilization map
+        util = controller.topology_manager.link_util
+        assert util and any(v > 0 for v in util.values())
+
+    def test_broadcast_over_wire(self):
+        fabric, controller, MAC = self._stack()
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], "ff:ff:ff:ff:ff:ff"))
+        for d in (2, 3, 4):
+            assert len(fabric.hosts[MAC[d]].received) == 1
