@@ -93,6 +93,12 @@ class Config:
     #: run the monitor app (reference: run_router_no_monitor.sh omits it)
     enable_monitor: bool = True
 
+    #: run the LLDP discovery app (the reference's --observe-links flag,
+    #: run_router.sh:2): the controller floods LLDP probes and learns
+    #: links/hosts from packet-ins instead of trusting direct entity
+    #: events — pair with Fabric(discovery="packet")
+    observe_links: bool = False
+
     # --- tracing / profiling (SURVEY §5: reference has none) -------------
     #: JSONL structured trace log path ("" = disabled); records oracle
     #: invocations with wall times (utils/tracing.py)

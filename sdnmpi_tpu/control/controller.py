@@ -37,6 +37,13 @@ class Controller:
         self.monitor: Optional[Monitor] = (
             Monitor(self.bus, southbound, config) if config.enable_monitor else None
         )
+        # --observe-links equivalent (reference: run_router.sh:2): learn
+        # links/hosts from LLDP probes + traffic instead of entity events
+        self.discovery = None
+        if config.observe_links:
+            from sdnmpi_tpu.control.discovery import LLDPDiscovery
+
+            self.discovery = LLDPDiscovery(self.bus, southbound, config)
 
     def attach(self) -> None:
         """Connect the southbound fabric and replay discovery."""

@@ -291,12 +291,22 @@ class Fabric:
     array-native collective install is this framework's extension with
     no OF 1.0 equivalent (see protocol/ofwire.py docstring)."""
 
-    def __init__(self, wire: bool = False) -> None:
+    def __init__(self, wire: bool = False, discovery: str = "direct") -> None:
+        if discovery not in ("direct", "packet"):
+            raise ValueError(f"unknown discovery mode {discovery!r}")
         self.switches: dict[int, SimSwitch] = {}
         self.hosts: dict[str, SimHost] = {}
         self.links: list[tuple[int, int, int, int]] = []  # (a, pa, b, pb)
         self.bus = None  # set by connect()
         self.wire = wire
+        #: "direct" publishes EventLinkAdd/EventHostAdd itself;
+        #: "packet" announces only what a real OF channel would (datapath
+        #: up + port sets) and leaves links/hosts for the controller's
+        #: LLDP discovery app to learn from actual frames (the
+        #: reference's --observe-links posture). Deletions stay
+        #: event-driven either way: a real switch reports port-down /
+        #: connection loss on the OF channel directly.
+        self.discovery = discovery
         self._xid = 0
 
     def _next_xid(self) -> int:
@@ -330,7 +340,7 @@ class Fabric:
         self.links.append((a, port_a, b, port_b))
         self._port_added(a)
         self._port_added(b)
-        if self.bus is not None:
+        if self.bus is not None and self.discovery == "direct":
             for link in self._link_entities(a, port_a, b, port_b):
                 self.bus.publish(EventLinkAdd(link))
 
@@ -339,7 +349,7 @@ class Fabric:
         self.hosts[mac] = host
         self.switches[dpid].port(port_no).peer = ("host", mac)
         self._port_added(dpid)
-        if self.bus is not None:
+        if self.bus is not None and self.discovery == "direct":
             self.bus.publish(EventHostAdd(host.to_entity()))
         return host
 
@@ -403,6 +413,10 @@ class Fabric:
         for dpid, sw in sorted(self.switches.items()):
             bus.publish(EventDatapathUp(dpid))
             bus.publish(EventSwitchEnter(sw.to_entity()))
+        if self.discovery != "direct":
+            # links/hosts must be learned from frames (LLDP probes fired
+            # by the discovery app's EventSwitchEnter handler + traffic)
+            return
         for a, pa, b, pb in self.links:
             for link in self._link_entities(a, pa, b, pb):
                 bus.publish(EventLinkAdd(link))

@@ -102,13 +102,17 @@ async def amain(args) -> None:
         collective_policy=args.policy,
         trace_log=args.trace_log or "",
         profile_dir=args.profile_dir or "",
+        observe_links=args.observe_links,
     )
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
 
         set_trace_sink(config.trace_log)
     spec = parse_topo(args.topo)
-    fabric = spec.to_fabric()
+    fabric = spec.to_fabric(
+        wire=args.wire,
+        discovery="packet" if args.observe_links else "direct",
+    )
     controller = Controller(fabric, config)
     controller.attach()
 
@@ -178,6 +182,19 @@ def main(argv=None) -> None:
         choices=["balanced", "adaptive", "shortest"],
         default="balanced",
         help="routing policy for proactive collective batches",
+    )
+    parser.add_argument(
+        "--observe-links",
+        action="store_true",
+        help="learn links/hosts via LLDP probes + traffic instead of "
+        "direct entity events (the reference's --observe-links, "
+        "run_router.sh:2)",
+    )
+    parser.add_argument(
+        "--wire",
+        action="store_true",
+        help="round-trip every southbound message through the byte-level "
+        "OpenFlow 1.0 codec (protocol/ofwire.py)",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
     parser.add_argument("--profile-dir", help="jax.profiler trace output dir")

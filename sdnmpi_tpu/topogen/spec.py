@@ -53,10 +53,10 @@ class TopoSpec:
             db.add_host(Host(mac, Port(dpid, port_no)))
         return db
 
-    def to_fabric(self):
+    def to_fabric(self, **fabric_kw):
         from sdnmpi_tpu.control.fabric import Fabric
 
-        fabric = Fabric()
+        fabric = Fabric(**fabric_kw)
         for dpid in self.switches:
             fabric.add_switch(dpid)
         for a, pa, b, pb in self.links:

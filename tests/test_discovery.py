@@ -1,0 +1,204 @@
+"""Packet-level LLDP discovery (control/discovery.py).
+
+The reference learns links via LLDP under --observe-links
+(reference: run_router.sh:2, consumed at sdnmpi/topology.py:184-202).
+These tests prove the equivalent mechanism: a controller attached to a
+``Fabric(discovery="packet")`` — which announces only datapaths and
+port sets, never links or hosts — converges to the SAME TopologyDB
+state as direct entity events, purely from LLDP probe frames and host
+traffic.
+"""
+
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.fabric import Fabric
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.lldp import decode_lldp, encode_lldp
+from tests.test_control import MAC
+
+
+def build_diamond(**fabric_kw):
+    fabric = Fabric(**fabric_kw)
+    for d in (1, 2, 3, 4):
+        fabric.add_switch(d)
+    fabric.add_link(1, 2, 2, 2)
+    fabric.add_link(1, 3, 3, 3)
+    fabric.add_link(2, 3, 4, 2)
+    fabric.add_link(3, 2, 4, 3)
+    for d in (1, 2, 3, 4):
+        fabric.add_host(MAC[d], d, 1)
+    return fabric
+
+
+def send_announcements(fabric):
+    for rank, d in enumerate((1, 2, 3, 4)):
+        fabric.hosts[MAC[d]].send(of.Packet(
+            MAC[d], "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        ))
+
+
+class TestLLDPCodec:
+    @pytest.mark.parametrize("dpid,port", [(1, 1), (0xDEAD, 47), (2**48, 65000)])
+    def test_roundtrip(self, dpid, port):
+        pkt = encode_lldp(dpid, port)
+        assert pkt.eth_type == of.ETH_TYPE_LLDP
+        assert decode_lldp(pkt) == (dpid, port)
+
+    def test_foreign_frames_rejected(self):
+        with pytest.raises(ValueError):
+            decode_lldp(of.Packet(MAC[1], MAC[2]))  # not LLDP at all
+        with pytest.raises(ValueError):
+            decode_lldp(of.Packet(
+                MAC[1], "01:80:c2:00:00:0e", eth_type=of.ETH_TYPE_LLDP,
+                payload=b"\x02\x0b\x07real-switch",  # foreign chassis id
+            ))
+
+
+class TestPacketDiscovery:
+    def _stacks(self, **extra_fabric_kw):
+        direct = build_diamond()
+        c_direct = Controller(direct, Config(oracle_backend="py"))
+        c_direct.attach()
+
+        packet = build_diamond(discovery="packet", **extra_fabric_kw)
+        c_packet = Controller(
+            packet, Config(oracle_backend="py", observe_links=True)
+        )
+        c_packet.attach()  # EventSwitchEnter replay fires the LLDP probes
+        return direct, c_direct, packet, c_packet
+
+    def test_links_learned_from_lldp(self):
+        _, c_direct, _, c_packet = self._stacks()
+        db_d = c_direct.topology_manager.topologydb
+        db_p = c_packet.topology_manager.topologydb
+        assert sorted(db_p.switches) == sorted(db_d.switches)
+
+        def link_set(db):
+            return {
+                (s, l.src.port_no, d, l.dst.port_no)
+                for s, dsts in db.links.items()
+                for d, l in dsts.items()
+            }
+
+        assert link_set(db_p) == link_set(db_d)
+        assert len(link_set(db_p)) == 8  # both directed halves of 4 links
+
+    def test_hosts_learned_from_traffic(self):
+        _, c_direct, packet, c_packet = self._stacks()
+        db_p = c_packet.topology_manager.topologydb
+        assert db_p.hosts == {}  # nothing sent yet: no hosts known
+        send_announcements(packet)
+        db_d = c_direct.topology_manager.topologydb
+        assert {
+            m: (h.port.dpid, h.port.port_no) for m, h in db_p.hosts.items()
+        } == {
+            m: (h.port.dpid, h.port.port_no) for m, h in db_d.hosts.items()
+        }
+        # ranks also registered on the way through (same packet-ins)
+        assert c_packet.process_manager.rankdb.get_mac(0) == MAC[1]
+
+    def test_routing_works_on_discovered_topology(self):
+        _, _, packet, c_packet = self._stacks()
+        send_announcements(packet)
+        packet.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        delivered = [
+            p for p in packet.hosts[MAC[4]].received
+            if p.eth_type != of.ETH_TYPE_LLDP
+        ]
+        assert len(delivered) == 1
+        assert c_packet.router.fdb.exists(1, MAC[1], MAC[4])
+        # discovered state routes identically to direct state
+        db = c_packet.topology_manager.topologydb
+        assert db.find_route(MAC[1], MAC[4]) == [(1, 2), (2, 3), (4, 1)]
+
+    def test_discovery_over_wire_bytes(self):
+        """LLDP probes + packet-ins crossing the OF 1.0 byte codec."""
+        _, c_direct, packet, c_packet = self._stacks(wire=True)
+        send_announcements(packet)
+        db_d = c_direct.topology_manager.topologydb
+        db_p = c_packet.topology_manager.topologydb
+
+        def norm(d):
+            key = lambda l: (l["src"]["dpid"], l["src"]["port_no"])  # noqa: E731
+            return sorted(d["links"], key=key), sorted(
+                d["hosts"], key=lambda h: h["mac"]
+            )
+
+        assert norm(db_p.to_dict()) == norm(db_d.to_dict())
+
+    def test_live_cabling_probed_automatically(self):
+        _, _, packet, c_packet = self._stacks()
+        db = c_packet.topology_manager.topologydb
+        packet.add_switch(9)
+        packet.add_link(4, 9, 9, 1)  # EventPortAdd fires targeted probes
+        assert 9 in db.links.get(4, {}) and 4 in db.links.get(9, {})
+
+    def test_recabled_link_rediscovered(self):
+        """A link removed and re-cabled onto the SAME ports must be
+        re-probed and re-learned (known-port tracking alone would skip
+        it forever)."""
+        _, _, packet, c_packet = self._stacks()
+        db = c_packet.topology_manager.topologydb
+        packet.remove_link(1, 2, 2, 2)
+        assert 2 not in db.links.get(1, {})
+        packet.add_link(1, 2, 2, 2)
+        assert 2 in db.links.get(1, {}) and 1 in db.links.get(2, {})
+
+    def test_host_on_freed_link_port_learned(self):
+        """A host cabled onto a former inter-switch port must not stay
+        classified as transit."""
+        _, _, packet, c_packet = self._stacks()
+        packet.remove_link(1, 2, 2, 2)
+        host = packet.add_host("04:00:00:00:00:99", 1, 2)
+        host.send(of.Packet("04:00:00:00:00:99", "ff:ff:ff:ff:ff:ff"))
+        db = c_packet.topology_manager.topologydb
+        assert "04:00:00:00:00:99" in db.hosts
+        assert db.hosts["04:00:00:00:00:99"].port.port_no == 2
+
+    def test_moved_host_relearned(self):
+        """A host that re-attaches elsewhere is re-announced; the
+        TopologyDB upserts its location by MAC."""
+        _, _, packet, c_packet = self._stacks()
+        send_announcements(packet)
+        db = c_packet.topology_manager.topologydb
+        assert (db.hosts[MAC[1]].port.dpid, db.hosts[MAC[1]].port.port_no) == (1, 1)
+        # re-attach h1 on switch 2 port 5 and have it speak
+        moved = packet.add_host(MAC[1], 2, 5)
+        moved.send(of.Packet(MAC[1], "ff:ff:ff:ff:ff:ff"))
+        assert (db.hosts[MAC[1]].port.dpid, db.hosts[MAC[1]].port.port_no) == (2, 5)
+
+    def test_truncated_lldp_skipped(self):
+        """A malformed port-id TLV is a ValueError skip, not a crash."""
+        import struct as _s
+
+        from sdnmpi_tpu.protocol.lldp import LLDP_MAC_NEAREST_BRIDGE
+
+        _, _, packet, c_packet = self._stacks()
+        bad = of.Packet(
+            "04:00:00:00:00:07", LLDP_MAC_NEAREST_BRIDGE,
+            eth_type=of.ETH_TYPE_LLDP,
+            payload=(
+                _s.pack("!H", (1 << 9) | 22) + b"\x07" + b"dpid:" + b"0" * 16
+                + _s.pack("!H", (2 << 9) | 3) + b"\x02\x00\x01"  # short port id
+            ),
+        )
+        with pytest.raises(ValueError):
+            decode_lldp(bad)
+        # through the packet-in path it is silently skipped
+        packet.packet_in(1, 1, bad)
+        assert (0x30303030, 1) not in c_packet.discovery.links
+
+    def test_transit_port_never_misread_as_host(self):
+        """A unicast packet transiting an inter-switch link must not
+        register the src MAC as a host on the transit port."""
+        _, _, packet, c_packet = self._stacks()
+        send_announcements(packet)
+        packet.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[4]))
+        db = c_packet.topology_manager.topologydb
+        assert db.hosts[MAC[1]].port.dpid == 1
+        assert db.hosts[MAC[1]].port.port_no == 1
