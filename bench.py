@@ -106,11 +106,15 @@ def build_problem():
 
 
 def main() -> None:
-    import jax
-
+    from benchmarks.common import retry_backend_init
     from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
 
-    log(f"devices: {jax.devices()}")
+    import jax
+
+    # transient UNAVAILABLE from the TPU plugin at init cost a round's
+    # number once (BENCH_r02); bounded retry makes init failures loud
+    # but not fatal
+    log(f"devices: {retry_backend_init()}")
     # dist_d: distances depend only on the topology — computed once per
     # topology version (the RouteOracle cache discipline), reused per
     # collective and by the validation below
@@ -149,13 +153,15 @@ def main() -> None:
 
     from benchmarks.common import stream_throughput
 
-    value, hosts = stream_throughput(
+    value, hosts, window_times = stream_throughput(
         lambda i: np.asarray(dispatch(100 + i)),
         n_stream=N_MEAS, readers=READERS, windows=N_WINDOWS,
     )
+    windows_ms = [round(w, 3) for w in window_times]
     congs = [unpack_result(h, n_flows, max_len)[1] for h in hosts]
     log(f"steady-state: best of {N_WINDOWS} windows x {N_MEAS} collectives "
-        f"({READERS} reader threads) -> {value:.2f} ms per collective")
+        f"({READERS} reader threads) -> {value:.2f} ms per collective "
+        f"(windows: {windows_ms})")
 
     # validation + context (untimed): decode every route, recompute the
     # exact discrete link loads, compare against naive single-path routing
@@ -195,6 +201,10 @@ def main() -> None:
                 "value": round(value, 3),
                 "unit": "ms",
                 "vs_baseline": round(TARGET_MS / value, 3),
+                # run-to-run spread next to the best-of headline: the
+                # remote-TPU tunnel adds bursty jitter (13.6 vs 20.4 ms
+                # for the same workload across rounds needs a number)
+                "windows_ms": windows_ms,
             }
         )
     )

@@ -60,20 +60,56 @@ def stream_throughput(dispatch_fetch, n_stream: int = 16, readers: int = 8,
     materialize its result on the host (np.asarray). Calls run on a
     ``readers``-thread pool so device compute, result readback, and any
     small input uploads overlap — how the controller consumes the
-    oracle. Returns ``(best ms/item over the windows, all results)``;
-    best-of-windows because a remote TPU tunnel adds bursty jitter.
+    oracle. Returns ``(best ms/item, all results, per-window ms)``;
+    best-of-windows because a remote TPU tunnel adds bursty jitter, and
+    the per-window figures put the run-to-run spread on record next to
+    the headline.
     """
     from concurrent.futures import ThreadPoolExecutor
 
     pool = ThreadPoolExecutor(readers)
     results = []
-    best = float("inf")
+    window_ms: list[float] = []
     for w in range(windows):
         t0 = time.perf_counter()
         futs = [
             pool.submit(dispatch_fetch, w * n_stream + i) for i in range(n_stream)
         ]
         outs = [f.result() for f in futs]
-        best = min(best, (time.perf_counter() - t0) / n_stream * 1e3)
+        window_ms.append((time.perf_counter() - t0) / n_stream * 1e3)
         results.extend(outs)
-    return best, results
+    log(
+        "stream windows (ms/item): "
+        + ", ".join(f"{t:.2f}" for t in window_ms)
+        + f" -> best {min(window_ms):.2f}, spread "
+        f"{max(window_ms) - min(window_ms):.2f}"
+    )
+    return min(window_ms), results, window_ms
+
+
+def retry_backend_init(retries: int = 5, base_delay: float = 5.0):
+    """Touch the accelerator with bounded retry/backoff.
+
+    A remote TPU plugin can return transient UNAVAILABLE at client
+    creation (this zeroed out a whole round's flagship number once —
+    BENCH_r02); retrying init is cheap insurance. Returns the device
+    list. Raises the last error after ``retries`` failures.
+    """
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            devices = jax.devices()
+            # one tiny op proves the runtime actually answers
+            jax.block_until_ready(jax.numpy.zeros(8) + 1)
+            return devices
+        except Exception as e:  # noqa: BLE001 — init errors vary by plugin
+            last = e
+            if attempt == retries - 1:
+                break  # no retry left: don't sleep, don't lie about it
+            delay = min(30.0, base_delay * (2 ** attempt))
+            log(f"backend init attempt {attempt + 1}/{retries} failed "
+                f"({e!r}); retrying in {delay:.0f}s")
+            time.sleep(delay)
+    raise RuntimeError(f"accelerator init failed after {retries} attempts") from last

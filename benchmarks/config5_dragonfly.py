@@ -79,7 +79,7 @@ def main() -> None:
                 pass
         return [np.asarray(o) for o in outs]
 
-    t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, _ = stream_throughput(dispatch_fetch, n_stream=10)
     t_route = t_route_ms / 1e3
     inter_m, n1m, n2m = run(1e9)  # hysteresis so high UGAL never detours
 

@@ -96,7 +96,7 @@ def main() -> None:
             pass
         return np.asarray(b)
 
-    t_route_ms, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, _ = stream_throughput(dispatch_fetch, n_stream=10)
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
     assert (nodes[:, 0] == usrc).all()

@@ -33,6 +33,16 @@ class Config:
     #: seconds between port-stats polls (reference: sdnmpi/monitor.py:24)
     monitor_interval: float = 1.0
 
+    # --- flow lifecycle --------------------------------------------------
+    #: idle/hard timeouts for installed routing flows, in seconds
+    #: (0 = permanent — the reference's only mode, sdnmpi/router.py:59).
+    #: Nonzero values make switches expire flows and report
+    #: EventFlowRemoved, which the Router consumes to keep the FDB
+    #: coherent — cashing the OFPFF_SEND_FLOW_REM the reference sets
+    #: but never handles (SURVEY §2 defect).
+    flow_idle_timeout: int = 0
+    flow_hard_timeout: int = 0
+
     # --- oracle ----------------------------------------------------------
     #: routing backend: "jax" (device tensors, batched) or "py"
     #: (pure-Python BFS used for differential testing)

@@ -241,6 +241,22 @@ class EventFDBUpdate(Event):
 
 
 @dataclasses.dataclass
+class EventFlowRemoved(Event):
+    """A switch expired a flow (idle/hard timeout) and reported it —
+    the OFPFF_SEND_FLOW_REM reply the reference requests on every
+    install but never handles (reference: sdnmpi/router.py:61; SURVEY
+    §2 defect). The Router consumes it to keep SwitchFDB coherent."""
+
+    dpid: int
+    match: Any  # protocol.openflow.Match
+    priority: int
+    reason: int  # protocol.ofwire.OFPRR_*
+    duration_sec: float = 0.0
+    packet_count: int = 0
+    byte_count: int = 0
+
+
+@dataclasses.dataclass
 class EventFDBRemove(Event):
     """Emitted when the router tears down a stale flow (no reference
     equivalent — the reference never removes flows, see SURVEY §2)."""

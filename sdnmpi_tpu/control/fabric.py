@@ -22,6 +22,7 @@ from sdnmpi_tpu.control.events import (
     EventHostAdd,
     EventLinkAdd,
     EventLinkDelete,
+    EventFlowRemoved,
     EventPacketIn,
     EventPortAdd,
     EventSwitchEnter,
@@ -53,6 +54,16 @@ class _FlowEntry:
     match: of.Match
     actions: tuple[of.Action, ...]
     seq: int  # insertion order tie-break
+    # expiry state (0 timeouts = permanent, the reference's only mode)
+    idle_timeout: int = 0
+    hard_timeout: int = 0
+    installed_at: float = 0.0
+    last_hit: float = 0.0
+    packet_count: int = 0
+    byte_count: int = 0
+    #: True for the per-lookup entries synthesized from the block table
+    #: (they carry no expiry state and are not in flow_table)
+    synthetic: bool = False
 
 
 class _BlockSetEntry:
@@ -148,8 +159,14 @@ class SimSwitch:
     def flow_mod(self, mod: of.FlowMod) -> None:
         if mod.command == of.OFPFC_ADD:
             self._seq += 1
+            now = self.fabric.now
             self.flow_table.append(
-                _FlowEntry(mod.priority, mod.match, mod.actions, self._seq)
+                _FlowEntry(
+                    mod.priority, mod.match, mod.actions, self._seq,
+                    idle_timeout=mod.idle_timeout,
+                    hard_timeout=mod.hard_timeout,
+                    installed_at=now, last_hit=now,
+                )
             )
             # highest priority first; earlier install wins ties
             self.flow_table.sort(key=lambda e: (-e.priority, e.seq))
@@ -191,7 +208,8 @@ class SimSwitch:
                 m = b.member(src_key, dst_key)
                 if m is not None:
                     best = _FlowEntry(
-                        b.priority, of.Match(), b.actions_for(m), b.seq
+                        b.priority, of.Match(), b.actions_for(m), b.seq,
+                        synthetic=True,
                     )
         return best
 
@@ -203,6 +221,12 @@ class SimSwitch:
         port.rx_bytes += _pkt_len(pkt)
 
         entry = self.lookup(pkt, in_port)
+        if entry is not None and not entry.synthetic:
+            # scalar-table hit: refresh the idle clock + counters (block
+            # entries are synthesized per lookup and don't expire)
+            entry.last_hit = self.fabric.now
+            entry.packet_count += 1
+            entry.byte_count += _pkt_len(pkt)
         if entry is None:
             # table miss -> controller (the reference runs ryu-manager with
             # --noexplicit-drop so unmatched packets reach the apps,
@@ -308,6 +332,9 @@ class Fabric:
         #: connection loss on the OF channel directly.
         self.discovery = discovery
         self._xid = 0
+        #: simulation clock: advanced by tick(); stamps flow install /
+        #: last-hit times for idle/hard expiry
+        self.now: float = 0.0
 
     def _next_xid(self) -> int:
         self._xid += 1
@@ -402,6 +429,60 @@ class Fabric:
         if self.bus is not None:
             self.bus.publish(EventSwitchLeave(sw.to_entity()))
             self.bus.publish(EventTopologyChanged())
+
+    # -- time / flow expiry ------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        """Advance the simulation clock and expire timed-out flows.
+
+        Real OF 1.0 switches age flows themselves and, because every
+        install sets OFPFF_SEND_FLOW_REM (as the reference does,
+        sdnmpi/router.py:61), report each expiry with ofp_flow_removed.
+        The reference never handles that reply (SURVEY §2 defect); here
+        the expiry is published as EventFlowRemoved — through the byte
+        codec when wire=True — and the Router keeps the FDB coherent.
+        """
+        self.now = now
+        for dpid, sw in sorted(self.switches.items()):
+            expired: list[tuple[_FlowEntry, int]] = []
+            for e in sw.flow_table:
+                if e.hard_timeout > 0 and now - e.installed_at >= e.hard_timeout:
+                    expired.append((e, 1))  # OFPRR_HARD_TIMEOUT
+                elif e.idle_timeout > 0 and now - e.last_hit >= e.idle_timeout:
+                    expired.append((e, 0))  # OFPRR_IDLE_TIMEOUT
+            if not expired:
+                continue
+            doomed = {id(e) for e, _ in expired}
+            sw.flow_table = [e for e in sw.flow_table if id(e) not in doomed]
+            for e, reason in expired:
+                self._flow_removed(dpid, e, reason)
+
+    def _flow_removed(self, dpid: int, e: _FlowEntry, reason: int) -> None:
+        if self.bus is None:
+            return
+        match, priority = e.match, e.priority
+        duration = self.now - e.installed_at
+        packets, bytes_ = e.packet_count, e.byte_count
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            rec = ofwire.decode_flow_removed(
+                ofwire.encode_flow_removed(
+                    match, priority, reason,
+                    duration_sec=int(duration), idle_timeout=e.idle_timeout,
+                    packet_count=packets, byte_count=bytes_,
+                    xid=self._next_xid(),
+                )
+            )
+            match, priority = rec["match"], rec["priority"]
+            reason, duration = rec["reason"], rec["duration_sec"]
+            packets, bytes_ = rec["packet_count"], rec["byte_count"]
+        self.bus.publish(
+            EventFlowRemoved(
+                dpid, match, priority, reason,
+                duration_sec=duration, packet_count=packets, byte_count=bytes_,
+            )
+        )
 
     # -- controller attachment --------------------------------------------
 

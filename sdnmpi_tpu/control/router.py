@@ -56,6 +56,7 @@ class Router:
         bus.subscribe(ev.EventPacketIn, self._packet_in)
         bus.subscribe(ev.EventTopologyChanged, lambda e: self._revalidate_flows())
         bus.subscribe(ev.EventProcessDelete, self._process_delete)
+        bus.subscribe(ev.EventFlowRemoved, self._flow_removed)
         bus.provide(ev.CurrentFDBRequest, self._current_fdb)
         bus.provide(ev.CurrentCollectivesRequest, self._current_collectives)
 
@@ -76,6 +77,8 @@ class Router:
             match=of.Match(dl_src=src, dl_dst=dst),
             actions=actions + (of.ActionOutput(out_port),),
             priority=self.config.priority_default,
+            idle_timeout=self.config.flow_idle_timeout,
+            hard_timeout=self.config.flow_hard_timeout,
         )
         self.southbound.flow_mod(dpid, mod)
 
@@ -401,6 +404,25 @@ class Router:
         self.bus.publish(ev.EventCollectiveRemoved(install.cookie))
 
     # -- flow lifecycle (no reference equivalent; SURVEY §2/§5) -----------
+
+    def _flow_removed(self, event: ev.EventFlowRemoved) -> None:
+        """A switch expired one of our flows (idle/hard timeout): drop
+        the bookkeeping so the dedup cannot suppress a reinstall, and
+        mirror the removal northbound. The switch already deleted its
+        entry, so no FlowMod goes south. This is the handler for the
+        OFPFF_SEND_FLOW_REM reply the reference requests but never
+        consumes (reference: sdnmpi/router.py:61; SURVEY §2 defect)."""
+        src, dst = event.match.dl_src, event.match.dl_dst
+        if src is None or dst is None:
+            return  # not one of the Router's exact-match flows
+        if not self.fdb.exists(event.dpid, src, dst):
+            return
+        log.info(
+            "flow expired on %s: %s -> %s (reason %d, %d pkts)",
+            event.dpid, src, dst, event.reason, event.packet_count,
+        )
+        self.fdb.remove(event.dpid, src, dst)
+        self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
 
     def _datapath_down(self, event: ev.EventDatapathDown) -> None:
         self.dps.discard(event.dpid)

@@ -47,15 +47,25 @@ class TopologyManager:
             pad_multiple=config.switch_pad_multiple,
             max_diameter=config.max_diameter,
         )
-        #: (dpid, port_no) -> latest tx_bps sample from the Monitor
+        #: (src_dpid, src_port) -> latest utilization of that directed
+        #: link in bps: max of the sender's tx stream and the receiver's
+        #: rx stream (the reference logs both, sdnmpi/monitor.py:79-88;
+        #: ingesting both means a one-sided counter stall cannot hide a
+        #: hot link). Pruned when links/switches leave, so a dead link's
+        #: last sample can never keep biasing the congestion base.
         self.link_util: dict[tuple[int, int], float] = {}
+        self._tx_util: dict[tuple[int, int], float] = {}
+        self._rx_util: dict[tuple[int, int], float] = {}
+        #: (dst_dpid, dst_port) -> (src_dpid, src_port) of the directed
+        #: link arriving there, for attributing rx samples
+        self._link_rev: dict[tuple[int, int], tuple[int, int]] = {}
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventSwitchEnter, lambda e: self.topologydb.add_switch(e.switch))
         bus.subscribe(ev.EventPortAdd, lambda e: self.topologydb.add_switch(e.switch))
-        bus.subscribe(ev.EventSwitchLeave, lambda e: self.topologydb.delete_switch(e.switch))
-        bus.subscribe(ev.EventLinkAdd, lambda e: self.topologydb.add_link(e.link))
-        bus.subscribe(ev.EventLinkDelete, lambda e: self.topologydb.delete_link(e.link))
+        bus.subscribe(ev.EventSwitchLeave, self._switch_leave)
+        bus.subscribe(ev.EventLinkAdd, self._link_add)
+        bus.subscribe(ev.EventLinkDelete, self._link_delete)
         bus.subscribe(ev.EventHostAdd, lambda e: self.topologydb.add_host(e.host))
         bus.subscribe(ev.EventPacketIn, self._packet_in)
         bus.subscribe(ev.EventPortStats, self._port_stats)
@@ -201,7 +211,51 @@ class TopologyManager:
             actions = tuple(of.ActionOutput(p) for p in ports)
             self.southbound.packet_out(dpid, of.PacketOut(data=pkt, actions=actions))
 
+    # -- discovery ingest + utilization hygiene ---------------------------
+
+    def _link_add(self, event) -> None:
+        link = event.link
+        self.topologydb.add_link(link)
+        self._link_rev[(link.dst.dpid, link.dst.port_no)] = (
+            link.src.dpid, link.src.port_no,
+        )
+
+    def _link_delete(self, event) -> None:
+        link = event.link
+        self.topologydb.delete_link(link)
+        self._link_rev.pop((link.dst.dpid, link.dst.port_no), None)
+        self._drop_util((link.src.dpid, link.src.port_no))
+
+    def _switch_leave(self, event) -> None:
+        self.topologydb.delete_switch(event.switch)
+        dpid = event.switch.dp.id
+        for key in [k for k in self.link_util if k[0] == dpid]:
+            self._drop_util(key)
+        self._link_rev = {
+            d: s for d, s in self._link_rev.items()
+            if d[0] != dpid and s[0] != dpid
+        }
+
+    def _drop_util(self, key: tuple[int, int]) -> None:
+        self.link_util.pop(key, None)
+        self._tx_util.pop(key, None)
+        self._rx_util.pop(key, None)
+
     # -- utilization ingest -----------------------------------------------
 
     def _port_stats(self, event: ev.EventPortStats) -> None:
-        self.link_util[(event.dpid, event.port_no)] = event.tx_bps
+        key = (event.dpid, event.port_no)
+        self._tx_util[key] = event.tx_bps
+        self._refresh_util(key)
+        # the rx counter of this port measures the link ARRIVING here;
+        # credit it to that link's source side (reference rx logging:
+        # sdnmpi/monitor.py:79-88)
+        src = self._link_rev.get(key)
+        if src is not None:
+            self._rx_util[src] = event.rx_bps
+            self._refresh_util(src)
+
+    def _refresh_util(self, key: tuple[int, int]) -> None:
+        self.link_util[key] = max(
+            self._tx_util.get(key, 0.0), self._rx_util.get(key, 0.0)
+        )

@@ -103,6 +103,8 @@ async def amain(args) -> None:
         trace_log=args.trace_log or "",
         profile_dir=args.profile_dir or "",
         observe_links=args.observe_links,
+        flow_idle_timeout=args.flow_idle_timeout,
+        flow_hard_timeout=args.flow_hard_timeout,
     )
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
@@ -131,6 +133,17 @@ async def amain(args) -> None:
     tasks = []
     if controller.monitor is not None:
         tasks.append(asyncio.create_task(controller.monitor.run()))
+
+    async def clock() -> None:
+        # drive the fabric's flow-expiry clock (a real switch ages its
+        # own flows; the sim needs the tick) — cheap no-op while all
+        # installed flows are permanent (the default timeouts)
+        loop = asyncio.get_running_loop()
+        while True:
+            fabric.tick(loop.time())
+            await asyncio.sleep(1.0)
+
+    tasks.append(asyncio.create_task(clock()))
     if not args.no_rpc:
         from sdnmpi_tpu.api.rpc import RPCInterface
 
@@ -195,6 +208,15 @@ def main(argv=None) -> None:
         action="store_true",
         help="round-trip every southbound message through the byte-level "
         "OpenFlow 1.0 codec (protocol/ofwire.py)",
+    )
+    parser.add_argument(
+        "--flow-idle-timeout", type=int, default=0,
+        help="idle expiry for routing flows in seconds (0 = permanent, "
+        "the reference's only mode)",
+    )
+    parser.add_argument(
+        "--flow-hard-timeout", type=int, default=0,
+        help="hard expiry for routing flows in seconds (0 = permanent)",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
     parser.add_argument("--profile-dir", help="jax.profiler trace output dir")
