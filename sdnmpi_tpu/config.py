@@ -76,6 +76,16 @@ class Config:
     dag_flow_threshold: int = 512
     #: congestion-reweighting rounds of the DAG balancer
     balance_rounds: int = 2
+    #: shard the flagship DAG balancer + sampler over the first N local
+    #: devices (parallel/mesh.route_collective_sharded): the traffic's
+    #: destination axis and the flow batch split across the mesh with
+    #: one psum per balance round. 0 = single-device. Hash streams are
+    #: keyed by global flow id, so sampled paths match the single-device
+    #: engine exactly when link loads sum exactly in f32 (idle fabrics,
+    #: dyadic splits); under measured utilization the psum's reduction
+    #: order can differ by ulps from the single-device matmul, which may
+    #: flip a near-tied Gumbel choice (see parallel/mesh.py contract).
+    mesh_devices: int = 0
     #: rank-pair count at or above which a proactive collective install
     #: uses the array-native block path (int MAC keys, shared
     #: FlowPathBlocks, one event per collective) instead of the

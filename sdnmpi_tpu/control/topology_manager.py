@@ -46,6 +46,7 @@ class TopologyManager:
             backend=config.oracle_backend,
             pad_multiple=config.switch_pad_multiple,
             max_diameter=config.max_diameter,
+            mesh_devices=config.mesh_devices,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed
         #: link in bps: max of the sender's tx stream and the receiver's

@@ -105,6 +105,7 @@ class TopologyDB:
         backend: str = "jax",
         pad_multiple: int = 8,
         max_diameter: int = 0,
+        mesh_devices: int = 0,
     ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
@@ -116,6 +117,7 @@ class TopologyDB:
         self.backend = backend
         self.pad_multiple = pad_multiple
         self.max_diameter = max_diameter
+        self.mesh_devices = mesh_devices
         self._version = 0
         self._oracle = None  # lazily-created JAX oracle (oracle/engine.py)
 
@@ -383,7 +385,10 @@ class TopologyDB:
         if self._oracle is None:
             from sdnmpi_tpu.oracle.engine import RouteOracle
 
-            self._oracle = RouteOracle(self.pad_multiple, self.max_diameter)
+            self._oracle = RouteOracle(
+                self.pad_multiple, self.max_diameter,
+                mesh_devices=self.mesh_devices,
+            )
         return self._oracle
 
 

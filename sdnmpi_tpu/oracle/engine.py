@@ -116,9 +116,37 @@ class RouteOracle:
     oracle/paths.py.
     """
 
-    def __init__(self, pad_multiple: int = 8, max_diameter: int = 0) -> None:
+    def __init__(
+        self,
+        pad_multiple: int = 8,
+        max_diameter: int = 0,
+        mesh_devices: int = 0,
+    ) -> None:
+        if mesh_devices:
+            import jax
+
+            if len(jax.devices()) < mesh_devices:
+                # decide up front, so the fallback doesn't keep paying
+                # an lcm-inflated pad for a mesh that can never exist
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "mesh_devices=%d but only %d devices; DAG engine "
+                    "stays single-device",
+                    mesh_devices, len(jax.devices()),
+                )
+                mesh_devices = 0
+            else:
+                # the sharded DAG engine splits the [T, V] traffic rows
+                # and the flow batch across all mesh devices; V must
+                # divide by the mesh size
+                import math
+
+                pad_multiple = math.lcm(pad_multiple, mesh_devices)
         self.pad_multiple = pad_multiple
         self.max_diameter = max_diameter
+        self.mesh_devices = mesh_devices
+        self._mesh = None  # lazily-built jax.sharding.Mesh
         self._version: Optional[int] = None
         self._tensors: Optional[TopoTensors] = None
         self._dist: Optional[np.ndarray] = None
@@ -450,7 +478,13 @@ class RouteOracle:
         program (utilization scatter + level-decomposed MXU balancing +
         fused path sampling + single packed readback), then the native
         slot decode. Returns [S, >=max_len] int32 node paths (-1 padded),
-        the same shape contract as the greedy scanner's output."""
+        the same shape contract as the greedy scanner's output.
+
+        With ``mesh_devices`` configured, the same program runs sharded
+        over the device mesh (parallel/mesh.route_collective_sharded),
+        one psum per balance round; sampled slots match single-device
+        exactly when loads sum exactly in f32 (see Config.mesh_devices
+        for the ulp caveat under measured utilization)."""
         from sdnmpi_tpu import native
         from sdnmpi_tpu.oracle.dag import route_collective, unpack_result
 
@@ -461,6 +495,24 @@ class RouteOracle:
         util = np.ascontiguousarray(base[li, lj], dtype=np.float32)
         traffic = np.zeros((t.v, t.v), np.float32)
         np.add.at(traffic, (dst_idx, src_idx), sub_w)
+
+        mesh = self._dag_mesh()
+        if mesh is not None and t.v % self.mesh_devices == 0:
+            from sdnmpi_tpu.oracle.dag import sampled_hops
+            from sdnmpi_tpu.parallel.mesh import route_collective_sharded
+
+            pad = (-len(src_idx)) % self.mesh_devices
+            src_p = np.concatenate([src_idx, np.full(pad, -1, np.int32)])
+            dst_p = np.concatenate([dst_idx, np.full(pad, -1, np.int32)])
+            slots_d, _maxc = route_collective_sharded(
+                t.adj, jnp.asarray(li), jnp.asarray(lj), jnp.asarray(util),
+                jnp.asarray(traffic), jnp.asarray(src_p), jnp.asarray(dst_p),
+                mesh, levels=max_len - 1, rounds=rounds, max_len=max_len,
+                dist=self._dist_d,
+            )
+            assert slots_d.shape[1] == sampled_hops(max_len)
+            slots = np.asarray(slots_d)[: len(src_idx)]
+            return self._decode(slots, src_idx, dst_idx)
 
         buf = route_collective(
             t.adj,
@@ -477,9 +529,26 @@ class RouteOracle:
             dist=self._dist_d,  # cached at this topology version: no BFS
         )
         slots, _ = unpack_result(np.asarray(buf), len(src_idx), max_len)
+        return self._decode(slots, src_idx, dst_idx)
+
+    def _decode(self, slots, src_idx, dst_idx):
+        """Shared slot decode of both DAG branches (C++ when built)."""
+        from sdnmpi_tpu import native
+
         return native.decode_slots(
             slots, self._order, src_idx, dst_idx, complete=True
         )
+
+    def _dag_mesh(self):
+        """The device mesh for the sharded DAG engine, or None when
+        single-device (device availability was settled in __init__)."""
+        if not self.mesh_devices:
+            return None
+        if self._mesh is None:
+            from sdnmpi_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(self.mesh_devices)
+        return self._mesh
 
     @_timed_batch("routes_batch_balanced")
     def routes_batch_balanced(

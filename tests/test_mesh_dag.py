@@ -118,6 +118,31 @@ def test_sharded_dag_under_utilization():
     _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
 
 
+def test_engine_mesh_devices_matches_single_device():
+    """The production seam: TopologyDB(mesh_devices=8) routes balanced
+    batches through the sharded DAG engine with fdbs identical to the
+    single-device oracle (Config.mesh_devices is just a scale knob)."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(4)
+    dbs = {
+        n: spec.to_topology_db(backend="jax", pad_multiple=8)
+        for n in (0, N_SHARDS)
+    }
+    for n, db in dbs.items():
+        db.mesh_devices = n
+
+    macs = sorted(dbs[0].hosts)[:12]
+    pairs = [(a, b) for a in macs for b in macs if a != b]
+    results = {}
+    for n, db in dbs.items():
+        fdbs, maxc = db.find_routes_batch_balanced(
+            pairs, dag_threshold=1, ecmp_ways=2,
+        )
+        results[n] = (fdbs, maxc)
+    assert results[0][0] == results[N_SHARDS][0]
+
+
 def test_sharded_dag_cached_dist():
     """Steady-state callers pass the cached APSP matrix; the sharded
     engine must honor it (no BFS) and still agree with the from-scratch
