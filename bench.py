@@ -49,7 +49,11 @@ ROUNDS = 2  # congestion-reweighting rounds
 READERS = 8  # host reader threads overlapping readback with compute
 N_WARM = 3
 N_MEAS = 16  # collectives per measurement window
-N_WINDOWS = 3  # best-of windows (the TPU tunnel adds bursty jitter)
+#: best-of windows: the TPU tunnel's latency is bursty on the scale of
+#: minutes (observed 12.6 ms and 40 ms for identical work an hour
+#: apart), so more cheap windows = better odds of sampling a quiet
+#: period; each window costs well under a second
+N_WINDOWS = 6
 
 
 def log(msg: str) -> None:

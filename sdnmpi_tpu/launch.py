@@ -105,6 +105,7 @@ async def amain(args) -> None:
         observe_links=args.observe_links,
         flow_idle_timeout=args.flow_idle_timeout,
         flow_hard_timeout=args.flow_hard_timeout,
+        mesh_devices=args.mesh_devices,
     )
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
@@ -217,6 +218,11 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--flow-hard-timeout", type=int, default=0,
         help="hard expiry for routing flows in seconds (0 = permanent)",
+    )
+    parser.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="shard the DAG balancer over the first N local devices "
+        "(0 = single-device)",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
     parser.add_argument("--profile-dir", help="jax.profiler trace output dir")

@@ -143,6 +143,26 @@ def test_engine_mesh_devices_matches_single_device():
     assert results[0][0] == results[N_SHARDS][0]
 
 
+def test_engine_mesh_devices_adaptive_matches_single_device():
+    """The UGAL engine path also dispatches to the mesh: identical fdbs
+    and detour counts on the virtual 8-device mesh."""
+    from sdnmpi_tpu.topogen import dragonfly
+
+    spec = dragonfly(4, 4)
+    results = {}
+    for n in (0, N_SHARDS):
+        db = spec.to_topology_db(backend="jax", pad_multiple=8)
+        db.mesh_devices = n
+        macs = sorted(db.hosts)[:10]
+        pairs = [(a, b) for a in macs for b in macs if a != b]
+        util = {}  # idle fabric: dyadic splits, exact parity expected
+        results[n] = db.find_routes_batch_adaptive(pairs, link_util=util)
+    fdbs0, det0, _ = results[0]
+    fdbs8, det8, _ = results[N_SHARDS]
+    assert fdbs0 == fdbs8
+    assert det0 == det8
+
+
 def test_sharded_dag_cached_dist():
     """Steady-state callers pass the cached APSP matrix; the sharded
     engine must honor it (no BFS) and still agree with the from-scratch

@@ -73,6 +73,52 @@ def test_switch_leave_prunes_samples():
     assert all(d[0] != 2 and s[0] != 2 for d, s in tm._link_rev.items())
 
 
+def test_async_monitor_loop_yields_mid_pass():
+    """Monitor.run() yields to the event loop IN THE MIDDLE of a
+    sampling pass (not just between passes): a heartbeat task must get
+    scheduled between _poll_one calls of one pass, so a 1,000-switch
+    fabric cannot starve the loop for a whole pass."""
+    import asyncio
+
+    fabric, controller = _stack()
+    monitor = controller.monitor
+    monitor.POLL_SLICE = 2  # yield after every 2nd of the 4 switches
+    fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
+
+    beat_at_poll = []  # heartbeat count observed at each _poll_one
+    beats = [0]
+    orig_poll_one = monitor._poll_one
+
+    def recording_poll_one(dpid, now):
+        beat_at_poll.append(beats[0])
+        return orig_poll_one(dpid, now)
+
+    monitor._poll_one = recording_poll_one
+
+    async def scenario():
+        async def heartbeat():
+            while True:
+                beats[0] += 1
+                await asyncio.sleep(0)
+
+        hb = asyncio.create_task(heartbeat())
+        mon = asyncio.create_task(monitor.run())
+        await asyncio.sleep(0.05)
+        mon.cancel()
+        hb.cancel()
+
+    asyncio.run(scenario())
+    # one pass polls 4 switches; slicing must let the heartbeat advance
+    # between the 2nd and 3rd poll of the SAME pass
+    first_pass = beat_at_poll[:4]
+    assert len(first_pass) == 4
+    assert first_pass[2] > first_pass[1], (
+        f"no yield mid-pass: heartbeat counts {first_pass}"
+    )
+    # and every switch was sampled (baseline entries recorded)
+    assert set(monitor.datapath_stats) == {1, 2, 3, 4}
+
+
 def test_stale_sample_cannot_bias_routing():
     """After a link dies with a hot sample on it, a fresh balanced batch
     sees no utilization for the ghost key (the bias the verdict called
