@@ -176,7 +176,7 @@ async def amain(args) -> None:
             task.cancel()
 
 
-def main(argv=None) -> None:
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="sdnmpi_tpu", description="TPU-native SDN-MPI controller"
     )
@@ -231,7 +231,11 @@ def main(argv=None) -> None:
     parser.add_argument("--duration", type=float, default=0, help="run time in seconds (0 = forever)")
     parser.add_argument("--checkpoint", help="write a state checkpoint on shutdown")
     parser.add_argument("--restore", help="restore state from a checkpoint file")
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
 
     setup_logging(args.profile)
     try:

@@ -658,7 +658,7 @@ class RouteOracle:
                 return results, 0.0
             nodes, _, _ = route_flows_balanced(
                 t.adj,
-                jnp.asarray(self._dist),
+                self._dist_d,  # cached device copy: no per-batch H2D
                 jnp.asarray(base.astype(np.float32)),
                 jnp.asarray(src_idx),
                 jnp.asarray(dst_idx),
