@@ -123,6 +123,11 @@ class Config:
     #: JSONL structured trace log path ("" = disabled); records oracle
     #: invocations with wall times (utils/tracing.py)
     trace_log: str = ""
+    #: JSONL control-plane event log ("" = disabled): every bus event as
+    #: one JSON line via a bus tap (utils/event_log.py) — the full
+    #: causal record, the fourth observability channel beyond the
+    #: reference's three (SURVEY §5)
+    event_log: str = ""
     #: jax.profiler trace output dir ("" = disabled); wraps the run in a
     #: TensorBoard-compatible device profile
     profile_dir: str = ""

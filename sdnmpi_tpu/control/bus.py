@@ -27,6 +27,13 @@ class EventBus:
         self._subscribers: dict[Type[Event], list[Callable[[Event], None]]] = (
             defaultdict(list)
         )
+        #: wildcard observers: called with EVERY published event, after
+        #: the typed subscribers (observability taps, e.g. the JSONL
+        #: event log — utils/event_log.py)
+        self._taps: list[Callable[[Event], None]] = []
+
+    def tap(self, handler: Callable[[Event], None]) -> None:
+        self._taps.append(handler)
 
     # -- request/reply ----------------------------------------------------
 
@@ -51,6 +58,14 @@ class EventBus:
         self._subscribers[event_type].append(handler)
 
     def publish(self, event: Event) -> None:
+        # taps BEFORE subscribers: handlers publish derived events
+        # synchronously from inside this dispatch, and the event log must
+        # record the cause ahead of its effects for offline causal replay
+        for tap in self._taps:
+            try:
+                tap(event)
+            except Exception:
+                log.exception("tap %r failed on %s", tap, type(event).__name__)
         for handler in list(self._subscribers[type(event)]):
             try:
                 handler(event)

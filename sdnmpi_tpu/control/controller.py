@@ -45,6 +45,14 @@ class Controller:
 
             self.discovery = LLDPDiscovery(self.bus, southbound, config)
 
+        # structured JSONL event log: a wildcard bus tap (SURVEY §5)
+        self.event_logger = None
+        if config.event_log:
+            from sdnmpi_tpu.utils.event_log import EventLogger
+
+            self.event_logger = EventLogger(config.event_log)
+            self.bus.tap(self.event_logger)
+
     def attach(self) -> None:
         """Connect the southbound fabric and replay discovery."""
         self.southbound.connect(self.bus)

@@ -106,6 +106,7 @@ async def amain(args) -> None:
         flow_idle_timeout=args.flow_idle_timeout,
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
+        event_log=args.event_log or "",
     )
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
@@ -172,6 +173,12 @@ async def amain(args) -> None:
 
             save_checkpoint(controller, args.checkpoint)
             log.info("checkpoint written to %s", args.checkpoint)
+        if controller.event_logger is not None:
+            log.info(
+                "event log: %d events -> %s",
+                controller.event_logger.n_events, config.event_log,
+            )
+            controller.event_logger.close()
         for task in tasks:
             task.cancel()
 
@@ -225,6 +232,10 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = single-device)",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
+    parser.add_argument(
+        "--event-log",
+        help="JSONL control-plane event log (every bus event, one line)",
+    )
     parser.add_argument("--profile-dir", help="jax.profiler trace output dir")
     parser.add_argument("--demo", action="store_true", help="generate demo MPI traffic")
     parser.add_argument("--demo-ranks", type=int, default=8)

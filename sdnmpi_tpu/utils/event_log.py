@@ -1,0 +1,82 @@
+"""Structured control-plane event log (JSONL).
+
+SURVEY §5 calls for a structured event log alongside the reference's
+three observability channels (logging split, Monitor TSV, WebSocket
+mirror — reference: logging.ini, sdnmpi/monitor.py:87-88,
+sdnmpi/rpc_interface.py:42-72). This module is that fourth channel: a
+bus tap serializing EVERY published event to one JSON line — the full
+causal record of what the control plane saw and did, greppable and
+replayable offline.
+
+Events are dataclasses; fields serialize compactly (entities through
+their ``to_dict``, arrays as shape summaries, packets as header
+tuples), so an alltoall's block install is one line, not 16.7M.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Optional, TextIO
+
+
+def _compact(value: Any) -> Any:
+    """JSON-safe, size-bounded rendering of an event field."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if hasattr(value, "to_dict"):
+        try:
+            return value.to_dict()
+        except Exception:
+            return repr(value)
+    if hasattr(value, "shape"):  # arrays: never inline the data
+        return {"shape": list(getattr(value, "shape", [])),
+                "dtype": str(getattr(value, "dtype", "?"))}
+    if dataclasses.is_dataclass(value):
+        return {
+            f.name: _compact(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+        }
+    if isinstance(value, bytes):
+        return {"bytes": len(value)}
+    if isinstance(value, (list, tuple, set)):
+        seq = list(value)
+        if len(seq) > 16:
+            return {"len": len(seq), "head": [_compact(x) for x in seq[:4]]}
+        return [_compact(x) for x in seq]
+    if isinstance(value, dict):
+        if len(value) > 16:
+            return {"len": len(value)}
+        return {str(k): _compact(v) for k, v in value.items()}
+    return repr(value)
+
+
+class EventLogger:
+    """Bus tap writing one JSON line per control-plane event.
+
+    Attach with ``bus.tap(EventLogger(path))`` (the Controller does this
+    when ``Config.event_log`` is set). ``close()`` flushes; the file is
+    line-buffered so a crash loses at most the current line.
+    """
+
+    def __init__(self, path: str, clock=time.time) -> None:
+        self.path = path
+        self.clock = clock
+        self._fh: Optional[TextIO] = open(path, "a", buffering=1)
+        self.n_events = 0
+
+    def __call__(self, event) -> None:
+        if self._fh is None:
+            return
+        record = {"t": round(self.clock(), 6), "event": type(event).__name__}
+        if dataclasses.is_dataclass(event):
+            for f in dataclasses.fields(event):
+                record[f.name] = _compact(getattr(event, f.name))
+        self._fh.write(json.dumps(record) + "\n")
+        self.n_events += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

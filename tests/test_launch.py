@@ -67,6 +67,7 @@ class TestLiveRun:
             duration = 0.2
             checkpoint = None
             restore = None
+            event_log = None
 
         for k, v in over.items():
             setattr(Args, k, v)
@@ -89,3 +90,29 @@ class TestLiveRun:
         asyncio.run(launch.amain(
             self._args(tmp_path, observe_links=True, wire=True)
         ))
+
+    def test_event_log_records_causal_stream(self, tmp_path):
+        """--event-log writes one JSON line per bus event: discovery,
+        process lifecycle, and FDB updates all on the record."""
+        path = str(tmp_path / "events.jsonl")
+        asyncio.run(launch.amain(self._args(tmp_path, event_log=path)))
+        records = [json.loads(l) for l in open(path)]
+        kinds = {r["event"] for r in records}
+        assert {"EventSwitchEnter", "EventLinkAdd", "EventHostAdd",
+                "EventProcessAdd", "EventFDBUpdate"} <= kinds
+        add = next(r for r in records if r["event"] == "EventProcessAdd")
+        assert "rank" in add and "mac" in add and "t" in add
+        # every line is independently parseable JSON (already proven by
+        # the loads above) and events are time-ordered
+        times = [r["t"] for r in records]
+        assert times == sorted(times)
+        # causal order: the packet-in that registers a rank is logged
+        # BEFORE the EventProcessAdd it causes (taps run ahead of the
+        # subscribers that publish derived events)
+        first_pktin = next(
+            i for i, r in enumerate(records) if r["event"] == "EventPacketIn"
+        )
+        first_add = next(
+            i for i, r in enumerate(records) if r["event"] == "EventProcessAdd"
+        )
+        assert first_pktin < first_add
