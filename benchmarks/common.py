@@ -87,29 +87,102 @@ def stream_throughput(dispatch_fetch, n_stream: int = 16, readers: int = 8,
     return min(window_ms), results, window_ms
 
 
-def retry_backend_init(retries: int = 5, base_delay: float = 5.0):
-    """Touch the accelerator with bounded retry/backoff.
+def _probe_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
+    """Touch the accelerator from a KILLABLE subprocess.
 
-    A remote TPU plugin can return transient UNAVAILABLE at client
-    creation (this zeroed out a whole round's flagship number once —
-    BENCH_r02); retrying init is cheap insurance. Returns the device
-    list. Raises the last error after ``retries`` failures.
+    A remote TPU tunnel can hang (not error) at client creation — a
+    blocked in-process ``jax.devices()`` is uninterruptible, so hang
+    detection needs process isolation. Returns (ok, detail)."""
+    import subprocess
+    import sys
+
+    code = (
+        # honor JAX_PLATFORMS even when a sitecustomize pinned the
+        # platform before env vars could apply (this environment does)
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "d = jax.devices()\n"
+        "jax.block_until_ready(jax.numpy.zeros(8) + 1)\n"
+        "print(d)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"hung for {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        err_lines = (proc.stderr or "").strip().splitlines()
+        return False, err_lines[-1] if err_lines else f"exit {proc.returncode}"
+    return True, proc.stdout.strip()
+
+
+def retry_backend_init(
+    retries: int = 5, base_delay: float = 5.0, probe_timeout: float = 120.0
+):
+    """Touch the accelerator with bounded retry/backoff + hang detection.
+
+    Two observed failure modes both cost a round's number once:
+    transient UNAVAILABLE at client creation (BENCH_r02) and a tunnel
+    that HANGS instead of erroring (round 4). Each attempt first probes
+    from a killable subprocess with a timeout, so hangs count as
+    failures and back off like errors do (the extra client init on
+    success, tens of seconds over a tunnel, is the price of retryable
+    hang detection); only a clean probe is followed by the in-process
+    init, which targets the SAME platform (both sides re-apply env
+    JAX_PLATFORMS over any sitecustomize pin) and runs under a watchdog
+    that hard-exits if the tunnel wedges in the probe-to-init window.
+    Returns the device list; raises after ``retries`` failures so the
+    driver gets a bounded, honest nonzero exit instead of a silent
+    stall.
     """
+    import os
+    import threading
+
     import jax
 
-    last = None
+    if os.environ.get("JAX_PLATFORMS"):
+        # mirror the probe subprocess exactly: without this, probe and
+        # init could target different backends under a sitecustomize pin
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    last: Exception | None = None
     for attempt in range(retries):
-        try:
-            devices = jax.devices()
-            # one tiny op proves the runtime actually answers
-            jax.block_until_ready(jax.numpy.zeros(8) + 1)
-            return devices
-        except Exception as e:  # noqa: BLE001 — init errors vary by plugin
-            last = e
-            if attempt == retries - 1:
-                break  # no retry left: don't sleep, don't lie about it
-            delay = min(30.0, base_delay * (2 ** attempt))
-            log(f"backend init attempt {attempt + 1}/{retries} failed "
-                f"({e!r}); retrying in {delay:.0f}s")
-            time.sleep(delay)
+        ok, detail = _probe_backend_subprocess(probe_timeout)
+        if ok:
+            # residual window: the backend can wedge between the probe
+            # subprocess tearing down its client and this init. A blocked
+            # native call is uninterruptible, so the watchdog hard-exits
+            # with a distinct code rather than stalling the round.
+            done = threading.Event()
+
+            def _watchdog():
+                if not done.wait(probe_timeout):
+                    log(
+                        f"backend init hung for {probe_timeout:.0f}s after a "
+                        "passing probe; aborting"
+                    )
+                    os._exit(3)
+
+            guard = threading.Thread(target=_watchdog, daemon=True)
+            guard.start()
+            try:
+                devices = jax.devices()
+                jax.block_until_ready(jax.numpy.zeros(8) + 1)
+                return devices
+            except Exception as e:  # noqa: BLE001 — init errors vary by plugin
+                last = e
+                detail = repr(e)
+            finally:
+                done.set()
+        else:
+            last = RuntimeError(f"backend probe failed: {detail}")
+        if attempt == retries - 1:
+            break  # no retry left: don't sleep, don't lie about it
+        delay = min(30.0, base_delay * (2 ** attempt))
+        log(f"backend init attempt {attempt + 1}/{retries} failed "
+            f"({detail}); retrying in {delay:.0f}s")
+        time.sleep(delay)
     raise RuntimeError(f"accelerator init failed after {retries} attempts") from last
