@@ -14,8 +14,7 @@ from sdnmpi_tpu.api.snapshot import (
 )
 from sdnmpi_tpu.config import Config
 from sdnmpi_tpu.control.controller import Controller
-from sdnmpi_tpu.protocol import openflow as of
-from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.announcement import AnnouncementType
 from tests.test_control import MAC, announce, ip_packet, make_diamond
 
 

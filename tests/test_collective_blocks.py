@@ -10,7 +10,6 @@ tests/test_control.py pins for the reference-shaped per-pair path.
 """
 
 import numpy as np
-import pytest
 
 from sdnmpi_tpu.config import Config
 from sdnmpi_tpu.control import events as ev

@@ -9,8 +9,6 @@ host recomputation from the returned fdbs — and that the dispatch seam
 (RouteOracle.dag_flow_threshold) selects between them.
 """
 
-import numpy as np
-
 from sdnmpi_tpu.oracle.engine import RouteOracle
 from sdnmpi_tpu.topogen import fattree
 

@@ -13,8 +13,6 @@ next packet transparently re-routes.
 from sdnmpi_tpu.config import Config
 from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.controller import Controller
-from sdnmpi_tpu.control.fabric import Fabric
-from sdnmpi_tpu.protocol import openflow as of
 from tests.test_control import MAC, ip_packet, make_diamond
 
 

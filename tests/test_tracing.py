@@ -2,10 +2,8 @@
 
 import json
 
-import numpy as np
 import pytest
 
-from sdnmpi_tpu.utils import tracing
 from sdnmpi_tpu.utils.tracing import OracleStats, STATS, set_trace_sink, trace_event
 
 
