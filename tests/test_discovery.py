@@ -59,6 +59,48 @@ class TestLLDPCodec:
             ))
 
 
+def link_set(db):
+    """Directed (src_dpid, src_port, dst_dpid, dst_port) tuples."""
+    return {
+        (s, l.src.port_no, d, l.dst.port_no)
+        for s, dsts in db.links.items()
+        for d, l in dsts.items()
+    }
+
+
+def test_discovery_scales_to_fattree8():
+    """LLDP discovery converges on a real fabric size (fat-tree k=8:
+    80 switches, 512 directed links) to the same link map as direct
+    events, announcing each directed link exactly once."""
+    from sdnmpi_tpu.control import events as ev
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(8)
+    direct = spec.to_fabric()
+    c_direct = Controller(direct, Config(oracle_backend="py"))
+    c_direct.attach()
+
+    packet = spec.to_fabric(discovery="packet")
+    c_packet = Controller(
+        packet, Config(oracle_backend="py", observe_links=True)
+    )
+    announced = []
+    c_packet.bus.subscribe(ev.EventLinkAdd, announced.append)
+    c_packet.attach()
+
+    got = link_set(c_packet.topology_manager.topologydb)
+    want = link_set(c_direct.topology_manager.topologydb)
+    assert got == want and len(got) == 512
+    # each directed link announced exactly once, even though every port
+    # is (re-)probed on every switch-enter/port-add event
+    keys = [
+        (e.link.src.dpid, e.link.src.port_no, e.link.dst.dpid,
+         e.link.dst.port_no)
+        for e in announced
+    ]
+    assert len(keys) == 512 and len(set(keys)) == 512
+
+
 class TestPacketDiscovery:
     def _stacks(self, **extra_fabric_kw):
         direct = build_diamond()
@@ -77,13 +119,6 @@ class TestPacketDiscovery:
         db_d = c_direct.topology_manager.topologydb
         db_p = c_packet.topology_manager.topologydb
         assert sorted(db_p.switches) == sorted(db_d.switches)
-
-        def link_set(db):
-            return {
-                (s, l.src.port_no, d, l.dst.port_no)
-                for s, dsts in db.links.items()
-                for d, l in dsts.items()
-            }
 
         assert link_set(db_p) == link_set(db_d)
         assert len(link_set(db_p)) == 8  # both directed halves of 4 links
