@@ -581,6 +581,51 @@ class RouteOracle:
         )
         return src_p, dst_p, w_p
 
+    def _adaptive_paths(
+        self, t, src_idx, dst_idx, weight, base, max_len, rounds,
+        ugal_candidates, ugal_bias,
+    ):
+        """UGAL dispatch shared by the list API and the array-native
+        collective path: sharded over the mesh when configured (flows
+        split across devices, the batch's traffic matrix psum-ed once,
+        hash streams keyed by global flow id — end-padding keeps the
+        real flows' ids, and therefore their choices, unchanged),
+        single-device otherwise. Returns (inter, n1, n2) numpy arrays
+        trimmed to the batch length."""
+        from sdnmpi_tpu.oracle.adaptive import route_adaptive
+
+        n = len(src_idx)
+        kwargs = dict(
+            levels=max_len - 1, rounds=rounds, max_len=max_len,
+            n_candidates=ugal_candidates, bias=ugal_bias,
+            max_degree=t.max_degree,
+            dist=self._dist_d,  # cached device copy: no per-batch H2D
+        )
+        mesh = self._dag_mesh()
+        if mesh is not None:
+            from sdnmpi_tpu.parallel.mesh import route_adaptive_sharded
+
+            src_p, dst_p, w_p = self._pad_flows(
+                np.asarray(src_idx, np.int32), np.asarray(dst_idx, np.int32),
+                np.asarray(weight, np.float32),
+            )
+            inter, n1, n2, _ = route_adaptive_sharded(
+                t.adj, jnp.asarray(base.astype(np.float32)),
+                jnp.asarray(src_p), jnp.asarray(dst_p), jnp.asarray(w_p),
+                t.n_real, mesh, **kwargs,
+            )
+        else:
+            inter, n1, n2, _ = route_adaptive(
+                t.adj, jnp.asarray(base.astype(np.float32)),
+                jnp.asarray(np.asarray(src_idx, np.int32)),
+                jnp.asarray(np.asarray(dst_idx, np.int32)),
+                jnp.asarray(np.asarray(weight, np.float32)),
+                jnp.int32(t.n_real), **kwargs,
+            )
+        return (
+            np.asarray(inter)[:n], np.asarray(n1)[:n], np.asarray(n2)[:n],
+        )
+
     def _dag_mesh(self):
         """The device mesh for the sharded DAG engine, or None when
         single-device (device availability was settled in __init__)."""
@@ -630,7 +675,6 @@ class RouteOracle:
         batch's average per-link share) so a hot link steers the balancer
         without overriding it outright.
         """
-        from sdnmpi_tpu.oracle.adaptive import link_loads
         from sdnmpi_tpu.oracle.congestion import route_flows_balanced
 
         t = self.refresh(db)
@@ -701,11 +745,7 @@ class RouteOracle:
         stitched path — the same quantity a host recomputation from the
         returned fdbs yields, not the balancer's fractional bound).
         """
-        from sdnmpi_tpu.oracle.adaptive import (
-            link_loads,
-            route_adaptive,
-            stitch_paths,
-        )
+        from sdnmpi_tpu.oracle.adaptive import stitch_paths
 
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
@@ -719,58 +759,16 @@ class RouteOracle:
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
             return results, 0, 0.0
-        levels = max_len - 1
 
         base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
 
-        mesh = self._dag_mesh()
-        if mesh is not None:
-            # UGAL sharded over the mesh (parallel/mesh.py): flows split
-            # across devices, the batch's traffic matrix psum-ed once, and
-            # hash streams keyed by global flow id (end-padding keeps the
-            # real flows' ids — and therefore their choices — unchanged)
-            from sdnmpi_tpu.parallel.mesh import route_adaptive_sharded
-
-            src_p, dst_p, w_p = self._pad_flows(src_idx, dst_idx, weight)
-            inter, n1, n2, _ = route_adaptive_sharded(
-                t.adj,
-                jnp.asarray(base.astype(np.float32)),
-                jnp.asarray(src_p),
-                jnp.asarray(dst_p),
-                jnp.asarray(w_p),
-                t.n_real,
-                mesh,
-                levels=levels,
-                rounds=rounds,
-                max_len=max_len,
-                n_candidates=ugal_candidates,
-                bias=ugal_bias,
-                max_degree=t.max_degree,
-                dist=self._dist_d,  # cached device copy: no per-batch H2D
-            )
-            inter = np.asarray(inter)[: len(src_idx)]
-            n1 = np.asarray(n1)[: len(src_idx)]
-            n2 = np.asarray(n2)[: len(src_idx)]
-        else:
-            inter, n1, n2, _ = route_adaptive(
-                t.adj,
-                jnp.asarray(base.astype(np.float32)),
-                jnp.asarray(src_idx),
-                jnp.asarray(dst_idx),
-                jnp.asarray(weight),
-                jnp.int32(t.n_real),
-                levels=levels,
-                rounds=rounds,
-                max_len=max_len,
-                n_candidates=ugal_candidates,
-                bias=ugal_bias,
-                max_degree=t.max_degree,
-                dist=self._dist_d,  # cached device copy: no per-batch H2D
-            )
+        inter, n1, n2 = self._adaptive_paths(
+            t, src_idx, dst_idx, weight, base, max_len, rounds,
+            ugal_candidates, ugal_bias,
+        )
         paths = stitch_paths(n1, n2, inter)
-        inter_h = np.asarray(inter)
         installed = self._materialize_fdbs(t, groups, group_subs, paths, results)
-        n_detours = sum(1 for _, g in installed if inter_h[g] >= 0)
+        n_detours = sum(1 for _, g in installed if inter[g] >= 0)
         return results, n_detours, self._installed_congestion(
             paths, installed, t.v
         )
@@ -939,25 +937,13 @@ class RouteOracle:
         n_detours = 0
         inter_h = None
         if policy == "adaptive":
-            from sdnmpi_tpu.oracle.adaptive import route_adaptive, stitch_paths
+            from sdnmpi_tpu.oracle.adaptive import stitch_paths
 
-            inter, n1, n2, _ = route_adaptive(
-                t.adj,
-                jnp.asarray(base.astype(np.float32)),
-                jnp.asarray(sub_src.astype(np.int32)),
-                jnp.asarray(sub_dst.astype(np.int32)),
-                jnp.asarray(sub_w),
-                jnp.int32(t.n_real),
-                levels=max_len - 1,
-                rounds=rounds,
-                max_len=max_len,
-                n_candidates=ugal_candidates,
-                bias=ugal_bias,
-                max_degree=t.max_degree,
-                dist=self._dist_d,
+            inter_h, n1, n2 = self._adaptive_paths(
+                t, sub_src, sub_dst, sub_w, base, max_len, rounds,
+                ugal_candidates, ugal_bias,
             )
-            paths = stitch_paths(n1, n2, inter)
-            inter_h = np.asarray(inter)
+            paths = stitch_paths(n1, n2, inter_h)
         elif policy == "shortest":
             from sdnmpi_tpu.oracle.paths import batch_paths
 

@@ -163,6 +163,32 @@ def test_engine_mesh_devices_adaptive_matches_single_device():
     assert det0 == det8
 
 
+def test_engine_mesh_collective_adaptive_matches_single_device():
+    """The array-native whole-collective path (the block-install seam)
+    also dispatches its adaptive branch through the mesh, with
+    identical routes."""
+    from sdnmpi_tpu.topogen import dragonfly
+
+    spec = dragonfly(4, 4)
+    results = {}
+    for n in (0, N_SHARDS):
+        db = spec.to_topology_db(backend="jax", pad_multiple=8)
+        db.mesh_devices = n
+        macs = sorted(db.hosts)[:12]
+        pairs = [(a, b) for a in range(12) for b in range(12) if a != b]
+        src_idx = np.array([p[0] for p in pairs], np.int32)
+        dst_idx = np.array([p[1] for p in pairs], np.int32)
+        results[n] = db.find_routes_collective(
+            macs, src_idx, dst_idx, policy="adaptive", link_util={},
+        )
+    r0, r8 = results[0], results[N_SHARDS]
+    np.testing.assert_array_equal(r0.pair_sub, r8.pair_sub)
+    np.testing.assert_array_equal(r0.hop_dpid, r8.hop_dpid)
+    np.testing.assert_array_equal(r0.hop_port, r8.hop_port)
+    np.testing.assert_array_equal(r0.hop_len, r8.hop_len)
+    assert r0.n_detours == r8.n_detours
+
+
 def test_sharded_dag_cached_dist():
     """Steady-state callers pass the cached APSP matrix; the sharded
     engine must honor it (no BFS) and still agree with the from-scratch
