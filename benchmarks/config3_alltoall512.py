@@ -55,12 +55,18 @@ def main() -> None:
     traffic = np.zeros((v, v), np.float32)
     traffic[udst, usrc] = weight
 
+    # destination set: only edge switches receive traffic
+    from sdnmpi_tpu.oracle.dag import make_dst_nodes
+
+    dst_nodes = make_dst_nodes(udst)
+
     args = [
         t.adj, jax.device_put(li.astype(np.int32)),
         jax.device_put(lj.astype(np.int32)), jax.device_put(util),
         jax.device_put(traffic), jax.device_put(usrc), jax.device_put(udst),
     ]
-    kw = dict(levels=levels, rounds=2, max_len=max_len, max_degree=t.max_degree)
+    kw = dict(levels=levels, rounds=2, max_len=max_len, max_degree=t.max_degree,
+              dst_nodes=jax.device_put(dst_nodes))
 
     def run():
         return np.asarray(route_collective(*args, **kw))

@@ -1,0 +1,183 @@
+"""Per-stage latency breakdown of route_collective on the real chip.
+
+Times each device stage of the flagship program in isolation — BFS
+distances, iterative DAG balancing, the destination-distance matmul,
+the path sampler — plus the fused end-to-end program, for any fat-tree
+size. This is the measurement tool behind the stage-cost model in
+oracle/dag.py: run it before and after kernel changes to see which
+stage actually moved.
+
+Usage: python -m benchmarks.profile_stages [k] [pad_multiple]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from benchmarks.common import log
+from sdnmpi_tpu.oracle import dag
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import fattree
+
+
+def _time(fn, n=10, windows=3):
+    """Pipelined per-item device time for fn() -> jax array.
+
+    Dispatch latency through the axon tunnel is tens of ms per call, so
+    sequential block-per-call timing measures the tunnel, not the chip.
+    Queue ``n`` calls back to back and block once; per-item time then
+    converges on actual device occupancy. Best-of-``windows`` guards
+    against tunnel latency bursts landing inside a window.
+    """
+    import jax
+
+    jax.block_until_ready(fn())  # compile + warm
+    per_item = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = [fn() for _ in range(n)]
+        jax.block_until_ready(out[-1])
+        per_item.append((time.perf_counter() - t0) * 1e3 / n)
+    return float(np.median(per_item)), float(np.min(per_item))
+
+
+def main(k: int = 32, pad_multiple: int = 128) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+
+    spec = fattree(k)
+    db = spec.to_topology_db(backend="jax", pad_multiple=pad_multiple)
+    t = tensorize(db, pad_multiple=pad_multiple)
+    v = t.adj.shape[0]
+    adj = np.asarray(t.adj)
+    log(f"fattree k={k}: {spec.n_switches} switches, padded V={v}")
+
+    host_edge = np.array(
+        [t.index[dpid] for _, dpid, _ in spec.hosts], np.int32
+    )
+    edges, counts = np.unique(host_edge, return_counts=True)
+    ga, gb = np.meshgrid(edges, edges, indexing="ij")
+    wa, wb = np.meshgrid(counts, counts, indexing="ij")
+    off = ga != gb
+    usrc = jax.device_put(ga[off].astype(np.int32))
+    udst = jax.device_put(gb[off].astype(np.int32))
+    weight = (wa[off] * wb[off]).astype(np.float32)
+    f = int(usrc.shape[0])
+
+    dist = apsp_distances(t.adj)
+    dist_h = np.asarray(dist)
+    levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
+    max_len = levels + 1
+    hops = dag.sampled_hops(max_len)
+    log(f"{f:,} flows, diameter {levels}, sampled hops {hops}, "
+        f"bfs_pallas={pallas_supported(v)} "
+        f"sampler_pallas={sampler_supported(v, hops, n_flows=f)}")
+
+    li, lj = (a.astype(np.int32) for a in np.nonzero(adj > 0))
+    util = jax.device_put(
+        (np.random.default_rng(0).random(len(li)) * 2e9).astype(np.float32)
+    )
+    li, lj = jax.device_put(li), jax.device_put(lj)
+    traffic = np.zeros((v, v), np.float32)
+    traffic[np.asarray(udst), np.asarray(usrc)] = weight
+    traffic = jax.device_put(traffic)
+
+    # -- stage: BFS distances ------------------------------------------
+    if pallas_supported(v):
+        med, best = _time(lambda: bfs_distances_pallas(t.adj, levels=levels))
+        log(f"bfs_pallas            {med:8.2f} ms  (best {best:.2f})")
+    med, best = _time(lambda: apsp_distances(t.adj))
+    log(f"apsp_xla              {med:8.2f} ms  (best {best:.2f})")
+
+    # -- stage: balance rounds (T = full V today) ----------------------
+    base = jnp.zeros((v, v), jnp.float32).at[li, lj].set(util)
+    bal = jax.jit(
+        lambda: dag.balance_rounds(t.adj, dist, base, traffic,
+                                   levels=levels, rounds=2)[1]
+    )
+    med, best = _time(bal)
+    log(f"balance_rounds (T={v}) {med:7.2f} ms  (best {best:.2f})")
+
+    weights, _, _ = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=levels, rounds=2
+    )
+    weights = jax.block_until_ready(weights)
+
+    # -- stage: destination-distance matmul (d2t) ----------------------
+    dist_t = jnp.where(jnp.isfinite(dist), dist, 16384.0).T.astype(jnp.bfloat16)
+    # reduce to a scalar on-device: the [F, V] product is ~2 GB at this
+    # shape, and the pipelined timer queues several outputs at once
+    d2t = jax.jit(
+        lambda: (jax.nn.one_hot(jnp.maximum(udst, 0), v, dtype=jnp.bfloat16)
+                 @ dist_t).astype(jnp.float32).sum()
+    )
+    med, best = _time(d2t)
+    log(f"d2t one-hot matmul    {med:8.2f} ms  (best {best:.2f})")
+
+    # -- stage: sampler ------------------------------------------------
+    if sampler_supported(v, hops, n_flows=f):
+        med, best = _time(
+            lambda: sample_slots_pallas(weights, dist, usrc, udst, hops)
+        )
+        log(f"sampler_pallas        {med:8.2f} ms  (best {best:.2f})")
+    med, best = _time(
+        lambda: dag.sample_paths_dense(weights, dist, usrc, udst, hops)[1]
+    )
+    log(f"sampler_xla           {med:8.2f} ms  (best {best:.2f})")
+
+    # -- destination-restricted variants (T = edge switches) -----------
+    dst_nodes = jax.device_put(jnp.asarray(dag.make_dst_nodes(udst)))
+    t_pad = int(dst_nodes.shape[0])
+    bal_r = jax.jit(
+        lambda: dag.balance_rounds(t.adj, dist, base, traffic,
+                                   levels=levels, rounds=2,
+                                   dst_nodes=dst_nodes)[1]
+    )
+    med, best = _time(bal_r)
+    log(f"balance_rounds (T={t_pad}) {med:6.2f} ms  (best {best:.2f})")
+    if sampler_supported(v, hops, n_flows=f, t_dst=t_pad):
+        med, best = _time(
+            lambda: sample_slots_pallas(
+                weights, dist, usrc, udst, hops, dst_nodes=dst_nodes
+            )
+        )
+        log(f"sampler_pallas (T-set){med:8.2f} ms  (best {best:.2f})")
+
+    # -- fused end-to-end ----------------------------------------------
+    med, best = _time(
+        lambda: dag.route_collective(
+            t.adj, li, lj, util, traffic, usrc, udst,
+            levels=levels, rounds=2, max_len=max_len,
+            max_degree=t.max_degree, dist=dist,
+        )
+    )
+    log(f"route_collective      {med:8.2f} ms  (best {best:.2f})")
+    med, best = _time(
+        lambda: dag.route_collective(
+            t.adj, li, lj, util, traffic, usrc, udst,
+            levels=levels, rounds=2, max_len=max_len,
+            max_degree=t.max_degree,
+        )
+    )
+    log(f"  incl. on-device BFS {med:8.2f} ms  (best {best:.2f})")
+    med, best = _time(
+        lambda: dag.route_collective(
+            t.adj, li, lj, util, traffic, usrc, udst,
+            levels=levels, rounds=2, max_len=max_len,
+            max_degree=t.max_degree, dist=dist, dst_nodes=dst_nodes,
+        )
+    )
+    log(f"  dst-restricted      {med:8.2f} ms  (best {best:.2f})")
+
+
+if __name__ == "__main__":
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    pad = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    main(k, pad)

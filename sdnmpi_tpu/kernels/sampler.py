@@ -53,23 +53,29 @@ _UNREACH = 16384.0
 _NO_LINK = -1e3  # candidates must exceed this (log-weight floor marker)
 
 
-def _pick_block(v: int) -> int:
+def _pick_block(v: int, t_dst: int = 0) -> int:
     """Largest flow strip whose working set fits the VMEM budget."""
     for b in (256, 128, 64):
-        if 2 * v * v + 8 * b * v * 4 <= _VMEM_BUDGET_BYTES:
+        if 2 * v * v + 2 * t_dst * v + 8 * b * v * 4 <= _VMEM_BUDGET_BYTES:
             return b
     return 64
 
 
 def sampler_supported(
-    v: int, hops: int, n_flows: int = 0, platform: str | None = None
+    v: int,
+    hops: int,
+    n_flows: int = 0,
+    platform: str | None = None,
+    t_dst: int | None = None,
 ) -> bool:
     """TPU platform, lane-aligned V, packable hop count, VMEM fit.
 
-    ``n_flows`` sizes the three full-array VMEM blocks the kernel rides
-    (src, dst, packed output — see ``_sampler_kernel``); they scale with
-    the flow batch, not V, so a huge batch at a large V must fall back
-    to the XLA sampler even when the [V, V] working set alone fits.
+    ``n_flows`` sizes the full-array VMEM blocks the kernel rides
+    (src, dst, dst-slot, packed output — see ``_sampler_kernel``); they
+    scale with the flow batch, not V, so a huge batch at a large V must
+    fall back to the XLA sampler even when the [V, V] working set alone
+    fits. ``t_dst`` is the destination-set length of the restricted
+    variant (adds the [T, V] bf16 d2e block; must be lane-aligned).
     """
     if not _HAS_PLTPU:
         return False
@@ -79,12 +85,17 @@ def sampler_supported(
         return False
     if v % 128 != 0 or not (1 <= hops <= 4):
         return False
-    block = _pick_block(v)
+    t = t_dst or 0
+    if t % 128 != 0:
+        return False
+    block = _pick_block(v, t)
     f_pad = ((n_flows + block - 1) // block) * block
-    # lw [V, V] bf16 + ~8 strips of [B, V] bf16/f32 at the chosen block
-    # + the three [F_pad] int32 full-array blocks, against the hard limit
+    n_full = 3 if t_dst is None else 4  # src, dst, [dslot,] out
+    # lw [V, V] bf16 [+ d2e [T, V] bf16] + ~8 strips of [B, V] bf16/f32
+    # at the chosen block + the [F_pad] int32 full-array blocks, against
+    # the hard limit
     return (
-        2 * v * v + 8 * block * v * 4 + 3 * f_pad * 4
+        2 * v * v + 2 * t * v + 8 * block * v * 4 + n_full * f_pad * 4
         <= _VMEM_HARD_BYTES - _VMEM_HEADROOM
     )
 
@@ -99,20 +110,51 @@ def _hash_u32(x):
     return x
 
 
-def _sampler_kernel(lw_ref, d2t_ref, src_ref, dst_ref, out_ref, *,
-                    hops: int, salt: int, block: int):
+def _sampler_kernel(*refs, hops: int, salt: int, block: int, dstset: bool):
     """One grid program: all sampled hops for ``block`` flows.
 
-    The per-flow scalar arrays (src, dst, packed output) ride as
-    full-array VMEM blocks (constant index map — loaded once, shared by
-    all programs) indexed dynamically by program id, because a
-    (1, block) strip violates the TPU (8, 128) block-tiling rule."""
+    The per-flow scalar arrays (src, dst, dst-slot, packed output) ride
+    as full-array VMEM blocks (constant index map — loaded once, shared
+    by all programs) indexed dynamically by program id, because a
+    (1, block) strip violates the TPU (8, 128) block-tiling rule.
+
+    Two input layouts share this body:
+    - full (``dstset=False``): the caller precomputes the [F, V]
+      destination-distance matrix outside and streams a [B, V] strip in;
+    - destination-set (``dstset=True``): the compact [T, V] d2e matrix
+      (rows = the collective's destination switches) rides in VMEM and
+      each program extracts its strip with a [B, T] x [T, V] one-hot
+      matmul — T is 2.5-4x smaller than V at fat-tree scale, so the
+      extraction FLOPs drop by the same factor and the [F, V] HBM
+      intermediate disappears entirely.
+    """
+    if dstset:
+        lw_ref, d2e_ref, dslot_ref, src_ref, dst_ref, out_ref = refs
+    else:
+        lw_ref, d2t_ref, src_ref, dst_ref, out_ref = refs
     i = pl.program_id(0)
     v = lw_ref.shape[1]
     cblk = col_block(v)
-    d2t = d2t_ref[:].astype(jnp.float32)  # [B, V] distance-to-own-dst
     src = src_ref[pl.ds(i, 1), :].reshape(block, 1)  # [B, 1] int32
     dst = dst_ref[pl.ds(i, 1), :].reshape(block, 1)
+
+    if dstset:
+        t = d2e_ref.shape[0]
+        slot_d = dslot_ref[pl.ds(i, 1), :].reshape(block, 1)  # [B, 1]
+        iota_t = jax.lax.broadcasted_iota(jnp.int32, (block, t), 1)
+        oh_d = (iota_t == slot_d).astype(jnp.bfloat16)  # [B, T]
+        d2t = jnp.concatenate(
+            [
+                jnp.dot(
+                    oh_d, d2e_ref[:, c * cblk:(c + 1) * cblk],
+                    preferred_element_type=jnp.float32,
+                )
+                for c in range(v // cblk)
+            ],
+            axis=1,
+        )  # [B, V] distance-to-own-dst
+    else:
+        d2t = d2t_ref[:].astype(jnp.float32)  # [B, V] distance-to-own-dst
 
     iota_v = jax.lax.broadcasted_iota(jnp.int32, (block, v), 1)
     fid = (
@@ -125,6 +167,10 @@ def _sampler_kernel(lw_ref, d2t_ref, src_ref, dst_ref, out_ref, *,
     src_oh = iota_v == jnp.maximum(src, 0)
     dsrc = jnp.max(jnp.where(src_oh, d2t, -1.0), axis=1, keepdims=True)
     alive0 = (src >= 0) & (dst >= 0) & (dsrc < _UNREACH)
+    if dstset:
+        # a flow whose dst is missing from the set has a zero one-hot
+        # row -> d2t identically 0 -> dsrc 0 < unreach; gate on the slot
+        alive0 &= slot_d >= 0
     node0 = jnp.where(alive0, src, -1)
 
     def hop(h, carry):
@@ -193,16 +239,20 @@ def sample_slots_pallas(
     hops: int,
     salt: int = 0,
     interpret: bool = False,
+    dst_nodes: jax.Array | None = None,  # [T] int32 destination set (-1 pad)
 ) -> jax.Array:
     """Sampled slot streams, [F, hops] int8 — drop-in for the slots
     output of ``sample_paths_dense(weights, dist, src, dst, hops)``.
 
     F is padded to the block size internally; V must be lane-aligned
-    (see ``sampler_supported``).
+    (see ``sampler_supported``). ``dst_nodes`` selects the destination-
+    set kernel layout (compact [T, V] d2e in VMEM; see kernel docstring);
+    T must be lane-aligned and cover every live flow's dst.
     """
     v = weights.shape[0]
     f = src.shape[0]
-    block = _pick_block(v)
+    t_dst = None if dst_nodes is None else dst_nodes.shape[0]
+    block = _pick_block(v, t_dst or 0)
     f_pad = ((f + block - 1) // block) * block
     pad = f_pad - f
 
@@ -213,36 +263,62 @@ def sample_slots_pallas(
 
     src_p = jnp.concatenate([src, jnp.full((pad,), -1, jnp.int32)])
     dst_p = jnp.concatenate([dst, jnp.full((pad,), -1, jnp.int32)])
-    # distance-to-own-destination strip: one bf16 matmul for the batch
-    oh_dst = jax.nn.one_hot(jnp.maximum(dst_p, 0), v, dtype=jnp.bfloat16)
-    d2t = (oh_dst @ dist_t).astype(jnp.bfloat16)  # [F_pad, V]
 
     nb = f_pad // block
     src2 = src_p.reshape(nb, block)
     dst2 = dst_p.reshape(nb, block)
 
     kernel = functools.partial(
-        _sampler_kernel, hops=hops, salt=salt, block=block
+        _sampler_kernel, hops=hops, salt=salt, block=block,
+        dstset=dst_nodes is not None,
     )
-    kwargs = {}
     if _HAS_PLTPU and not interpret:
         vm = lambda *s: pl.BlockSpec(s[0], s[1], memory_space=pltpu.VMEM)  # noqa: E731
     else:
         vm = lambda *s: pl.BlockSpec(s[0], s[1])  # noqa: E731
+    full = lambda: vm((nb, block), lambda i: (0, 0))  # noqa: E731
+
+    if dst_nodes is None:
+        # distance-to-own-destination strip: one bf16 matmul for the
+        # batch ([F, V] intermediate in HBM, streamed per program)
+        oh_dst = jax.nn.one_hot(jnp.maximum(dst_p, 0), v, dtype=jnp.bfloat16)
+        d2t = (oh_dst @ dist_t).astype(jnp.bfloat16)  # [F_pad, V]
+        operands = (lw, d2t, src2, dst2)
+        in_specs = [
+            vm((v, v), lambda i: (0, 0)),
+            vm((block, v), lambda i: (i, 0)),
+            full(),  # full array, see kernel
+            full(),
+        ]
+    else:
+        # compact destination rows; the per-flow strip extraction moves
+        # inside the kernel (one [B, T] x [T, V] matmul per program)
+        d2e = jnp.where(
+            (dst_nodes >= 0)[:, None],
+            dist_t[jnp.maximum(dst_nodes, 0)],
+            jnp.bfloat16(_UNREACH),
+        )  # [T, V]
+        eq = (dst_p[:, None] == dst_nodes[None, :]) & (dst_nodes >= 0)[None, :]
+        dslot = jnp.where(
+            jnp.any(eq, axis=1), jnp.argmax(eq, axis=1).astype(jnp.int32), -1
+        )
+        dslot2 = dslot.reshape(nb, block)
+        operands = (lw, d2e, dslot2, src2, dst2)
+        in_specs = [
+            vm((v, v), lambda i: (0, 0)),
+            vm((t_dst, v), lambda i: (0, 0)),
+            full(),
+            full(),
+            full(),
+        ]
     packed = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((nb, block), jnp.int32),
         grid=(nb,),
-        in_specs=[
-            vm((v, v), lambda i: (0, 0)),
-            vm((block, v), lambda i: (i, 0)),
-            vm((nb, block), lambda i: (0, 0)),  # full array, see kernel
-            vm((nb, block), lambda i: (0, 0)),
-        ],
-        out_specs=vm((nb, block), lambda i: (0, 0)),
+        in_specs=in_specs,
+        out_specs=full(),
         interpret=interpret,
-        **kwargs,
-    )(lw, d2t, src2, dst2)
+    )(*operands)
 
     words = packed.reshape(f_pad)[:f]  # [F] int32
     shifts = jnp.arange(hops, dtype=jnp.int32) * 8

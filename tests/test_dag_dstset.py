@@ -1,0 +1,165 @@
+"""Destination-set restriction of the flagship DAG engine.
+
+``route_collective(dst_nodes=...)`` contracts the balancing matmuls and
+the sampler's destination-distance extraction over the collective's T
+destination switches instead of all V — the dominant cost at fat-tree
+scale, where only edge switches receive traffic. The contract is
+bit-identical routed output vs the unrestricted path (one-hot row
+extraction is exact; the dropped destination rows carry zero traffic).
+
+These tests pin that contract on the CPU backend for every layer:
+balance_rounds, sample_paths_dense, the Pallas kernel (interpret mode),
+and the fused route_collective buffer.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sdnmpi_tpu.oracle import dag
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import fattree
+
+MAX_LEN = 5  # fat-tree k=8 diameter 4
+
+
+@pytest.fixture(scope="module")
+def problem():
+    """k=8 fat-tree alltoall over all edge switches, dst set -1 padded."""
+    spec = fattree(8)
+    db = spec.to_topology_db(backend="jax")
+    t = tensorize(db, pad_multiple=128)
+    v = t.adj.shape[0]
+    dist = apsp_distances(t.adj)
+
+    host_edge = sorted({t.index[h.port.dpid] for h in db.hosts.values()})
+    pairs = [(a, b) for a in host_edge for b in host_edge if a != b]
+    src = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    dst = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    weight = np.full(len(pairs), 2.0, np.float32)
+    traffic = np.zeros((v, v), np.float32)
+    traffic[np.asarray(dst), np.asarray(src)] = weight
+    traffic = jnp.asarray(traffic)
+
+    t_pad = 128  # lane-aligned destination set
+    dst_nodes = np.full(t_pad, -1, np.int32)
+    dst_nodes[: len(host_edge)] = host_edge  # sorted ascending
+    dst_nodes = jnp.asarray(dst_nodes)
+
+    base = jnp.zeros((v, v), jnp.float32)
+    return t, dist, traffic, base, src, dst, dst_nodes
+
+
+def test_balance_rounds_restricted_parity(problem):
+    t, dist, traffic, base, _, _, dst_nodes = problem
+    wf, lf, mf = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=4, rounds=2
+    )
+    wr, lr, mr = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=4, rounds=2, dst_nodes=dst_nodes
+    )
+    np.testing.assert_array_equal(np.asarray(wf), np.asarray(wr))
+    np.testing.assert_array_equal(np.asarray(lf), np.asarray(lr))
+    assert float(mf) == float(mr) and float(mf) > 0
+
+
+def test_sample_paths_dense_restricted_parity(problem):
+    t, dist, traffic, base, src, dst, dst_nodes = problem
+    weights, _, _ = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=4, rounds=2
+    )
+    nf, sf = dag.sample_paths_dense(weights, dist, src, dst, MAX_LEN, salt=7)
+    nr, sr = dag.sample_paths_dense(
+        weights, dist, src, dst, MAX_LEN, salt=7, dst_nodes=dst_nodes
+    )
+    np.testing.assert_array_equal(np.asarray(nf), np.asarray(nr))
+    np.testing.assert_array_equal(np.asarray(sf), np.asarray(sr))
+
+
+@pytest.mark.parametrize("hops", [1, 2, 3])
+def test_pallas_dstset_kernel_parity(problem, hops):
+    """Interpret-mode destination-set kernel == XLA sampler, bit for bit,
+    including flow-count padding (F is not a block multiple)."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
+
+    t, dist, traffic, base, src, dst, dst_nodes = problem
+    weights, _, _ = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=4, rounds=2
+    )
+    _, ref = dag.sample_paths_dense(weights, dist, src, dst, hops, salt=3)
+    got = sample_slots_pallas(
+        weights, dist, src, dst, hops, salt=3, interpret=True,
+        dst_nodes=dst_nodes,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_route_collective_restricted_buffer_identical(problem):
+    t, dist, traffic, base, src, dst, dst_nodes = problem
+    v = t.adj.shape[0]
+    adj_host = np.asarray(t.adj)
+    li, lj = (a.astype(np.int32) for a in np.nonzero(adj_host > 0))
+    util = jnp.asarray(np.linspace(0, 1e9, len(li), dtype=np.float32))
+    common = dict(levels=4, rounds=2, max_len=MAX_LEN, max_degree=t.max_degree)
+    full = dag.route_collective(
+        t.adj, jnp.asarray(li), jnp.asarray(lj), util, traffic, src, dst,
+        **common,
+    )
+    restricted = dag.route_collective(
+        t.adj, jnp.asarray(li), jnp.asarray(lj), util, traffic, src, dst,
+        dst_nodes=dst_nodes, **common,
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(restricted))
+    _, maxc = dag.unpack_result(np.asarray(restricted), int(src.shape[0]), MAX_LEN)
+    assert maxc > 0
+    assert v  # silence unused warning if asserts above are optimized away
+
+
+def test_missing_destination_reads_unroutable(problem):
+    """A flow whose dst is absent from dst_nodes must come back dead
+    (all -1 slots), not silently routed — both sampler formulations."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
+
+    t, dist, traffic, base, src, dst, dst_nodes = problem
+    weights, _, _ = dag.balance_rounds(
+        t.adj, dist, base, traffic, levels=4, rounds=2
+    )
+    # a destination that is a real switch but not in the set: any core
+    # switch (cores never appear among edge destinations)
+    in_set = set(np.asarray(dst_nodes).tolist())
+    outsider = next(i for i in range(t.n_real) if i not in in_set)
+    src1 = jnp.asarray([int(np.asarray(src)[0])], jnp.int32)
+    dst1 = jnp.asarray([outsider], jnp.int32)
+    _, s_xla = dag.sample_paths_dense(
+        weights, dist, src1, dst1, 3, dst_nodes=dst_nodes
+    )
+    s_pl = sample_slots_pallas(
+        weights, dist, src1, dst1, 3, interpret=True, dst_nodes=dst_nodes
+    )
+    assert (np.asarray(s_xla) == -1).all()
+    assert (np.asarray(s_pl) == -1).all()
+
+
+def test_make_dst_nodes_contract():
+    """Sorted unique, -1 padded, lane-aligned — and pads never collide
+    with a real destination."""
+    out = dag.make_dst_nodes(np.array([7, 3, 3, 200, -1, 7], np.int32))
+    assert out.shape == (128,) and out.dtype == np.int32
+    assert list(out[:3]) == [3, 7, 200] and (out[3:] == -1).all()
+    # already-aligned set stays at its size; oversize rolls to next lane
+    assert dag.make_dst_nodes(np.arange(128)).shape == (128,)
+    assert dag.make_dst_nodes(np.arange(129)).shape == (256,)
+
+
+def test_supported_gating_dstset():
+    from sdnmpi_tpu.kernels.sampler import sampler_supported
+
+    # destination-set length must be lane-aligned
+    assert not sampler_supported(1024, 3, n_flows=1000, t_dst=500)
+    # V=2048 with a big flow batch exceeds VMEM with the extra d2e block
+    # exactly when the full-layout variant does not — both must be
+    # consistent with the budget model rather than crash
+    assert isinstance(
+        sampler_supported(2048, 3, n_flows=261_632, t_dst=512), bool
+    )

@@ -71,3 +71,35 @@ def test_sampler_kernel_matches_xla(v):
     sp = np.asarray(sample_slots_pallas(weights, dist, src, dst, hops, salt=17))
     _, sd = sample_paths_dense(weights, dist, src, dst, hops, salt=17)
     np.testing.assert_array_equal(sp, np.asarray(sd))
+
+
+@pytest.mark.parametrize("v", [1024, 1280])
+def test_sampler_dstset_kernel_matches_xla(v):
+    """Destination-set kernel layout on real Mosaic: compact [T, V] d2e
+    in VMEM, in-kernel strip extraction — bit parity vs the XLA sampler
+    at fat-tree-like destination sets (T = 512 of V)."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import congestion_weights, sample_paths_dense
+
+    hops = 3
+    t_dst = 512
+    f = 8192
+    assert sampler_supported(v, hops, n_flows=f, t_dst=t_dst)
+    adj = jnp.asarray(_random_graph(v, seed=4))
+    rng = np.random.default_rng(5)
+    cost = jnp.asarray(rng.uniform(0, 4, (v, v)).astype(np.float32)) * adj
+    weights = congestion_weights(adj, cost)
+    dist = apsp_distances(adj)
+
+    members = np.sort(rng.choice(v, t_dst - 32, replace=False)).astype(np.int32)
+    dst_nodes = jnp.asarray(np.concatenate([members, np.full(32, -1, np.int32)]))
+    src = jnp.asarray(rng.integers(0, v, f).astype(np.int32))
+    dst = jnp.asarray(rng.choice(members, f).astype(np.int32))
+    sp = np.asarray(
+        sample_slots_pallas(
+            weights, dist, src, dst, hops, salt=23, dst_nodes=dst_nodes
+        )
+    )
+    _, sd = sample_paths_dense(weights, dist, src, dst, hops, salt=23)
+    np.testing.assert_array_equal(sp, np.asarray(sd))
