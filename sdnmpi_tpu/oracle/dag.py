@@ -121,10 +121,7 @@ def balance_rounds(
     if dst_nodes is None:
         dist_t = dist.T
     else:
-        valid = (dst_nodes >= 0)[:, None]
-        rows = jnp.maximum(dst_nodes, 0)
-        dist_t = jnp.where(valid, dist.T[rows], INF)  # pads never match a level
-        traffic = jnp.where(valid, traffic[rows], 0.0)
+        dist_t, traffic = restrict_dst(dist, traffic, dst_nodes)
     cost = base_cost
     weights = congestion_weights(adj_f, cost)
     load = propagate_levels(weights, dist_t, traffic, levels)
@@ -134,6 +131,25 @@ def balance_rounds(
         load = propagate_levels(weights, dist_t, traffic, levels)
     maxc = jnp.max(load)
     return weights, load, maxc
+
+
+def restrict_dst(
+    dist: jax.Array,  # [V, V] f32, dist[i, t]
+    traffic: jax.Array,  # [V, V] f32, traffic[t, i]
+    dst_nodes: jax.Array,  # [T] int32 destination set (-1 pad)
+) -> tuple[jax.Array, jax.Array]:
+    """Gather the destination-restricted [T, V] rows of dist.T/traffic.
+
+    The one device-side encoding of the dst_nodes pad convention (-1 =
+    pad; padded rows get inf distance so no level mask ever matches, and
+    zero traffic) — shared by ``balance_rounds`` and the sharded engine
+    (parallel/mesh.py) so the two paths cannot desynchronize.
+    """
+    valid = (dst_nodes >= 0)[:, None]
+    rows = jnp.maximum(dst_nodes, 0)
+    dist_t = jnp.where(valid, dist.T[rows], INF)
+    traffic_t = jnp.where(valid, traffic[rows], 0.0)
+    return dist_t, traffic_t
 
 
 def neighbor_table(

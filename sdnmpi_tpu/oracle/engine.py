@@ -528,20 +528,34 @@ class RouteOracle:
 
         mesh = self._dag_mesh()
         if mesh is not None and t.v % self.mesh_devices == 0:
-            from sdnmpi_tpu.oracle.dag import sampled_hops
+            from sdnmpi_tpu.oracle.dag import make_dst_nodes, sampled_hops
             from sdnmpi_tpu.parallel.mesh import route_collective_sharded
 
             src_p, dst_p, _ = self._pad_flows(src_idx, dst_idx)
+            dn = make_dst_nodes(dst_idx)  # 128-multiple: divides the mesh
+            # restriction only pays when T is actually smaller than V
+            # (the pad floor is 128) and T divides the mesh
+            use_dn = len(dn) < t.v and len(dn) % self.mesh_devices == 0
             slots_d, _maxc = route_collective_sharded(
                 t.adj, jnp.asarray(li), jnp.asarray(lj), jnp.asarray(util),
                 jnp.asarray(traffic), jnp.asarray(src_p), jnp.asarray(dst_p),
                 mesh, levels=max_len - 1, rounds=rounds, max_len=max_len,
                 dist=self._dist_d,
+                dst_nodes=jnp.asarray(dn) if use_dn else None,
             )
             assert slots_d.shape[1] == sampled_hops(max_len)
             slots = np.asarray(slots_d)[: len(src_idx)]
             return self._decode(slots, src_idx, dst_idx)
 
+        # destination set of this batch: restricts the balancing matmuls
+        # and the sampler's distance extraction to the rows that carry
+        # traffic (bit-identical routes). Lane-multiple padding buckets
+        # the jit shape so distinct collectives rarely retrace; on small
+        # topologies where the 128 pad floor reaches V, restriction
+        # would do MORE work than the full contraction, so skip it.
+        from sdnmpi_tpu.oracle.dag import make_dst_nodes
+
+        dn = make_dst_nodes(dst_idx)
         buf = route_collective(
             t.adj,
             jnp.asarray(li),
@@ -555,6 +569,7 @@ class RouteOracle:
             max_len=max_len,
             max_degree=t.max_degree,
             dist=self._dist_d,  # cached at this topology version: no BFS
+            dst_nodes=jnp.asarray(dn) if len(dn) < t.v else None,
         )
         slots, _ = unpack_result(np.asarray(buf), len(src_idx), max_len)
         return self._decode(slots, src_idx, dst_idx)

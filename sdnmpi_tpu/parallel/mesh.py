@@ -267,6 +267,7 @@ def route_collective_sharded(
     max_len: int,
     salt: int = 0,
     dist: jax.Array | None = None,  # cached APSP distances, else computed
+    dst_nodes: jax.Array | None = None,  # [T] int32 destination set (-1 pad)
 ) -> tuple[jax.Array, jax.Array]:
     """The flagship MXU DAG engine (oracle/dag.route_collective) sharded
     over every device of the mesh ("flow" x "v" axes flattened).
@@ -294,6 +295,14 @@ def route_collective_sharded(
     because the psum and the single-device matmul reduce in different
     orders.
 
+    ``dst_nodes`` applies the destination-set restriction of
+    ``route_collective(dst_nodes=...)`` to the sharded path: each device
+    propagates a T/n_shards block of the restricted [T, V] traffic
+    instead of a V/n_shards block of the full matrix (bit-identical —
+    the dropped rows carry zero traffic), and the samplers extract
+    destination distances from the compact [T, V] rows. T must divide by
+    the shard count.
+
     Returns ``(slots [F, sampled_hops(max_len)] int8, max_congestion
     f32 scalar)`` — the unpacked form of ``route_collective``'s buffer;
     decode with ``slots_to_nodes(..., complete=True)``. Requires V and F
@@ -310,14 +319,25 @@ def route_collective_sharded(
         raise ValueError(f"flow count {f} must divide by {n_shards} shards")
     have_dist = dist is not None
     dist_arg = dist if have_dist else jnp.zeros_like(adj, dtype=jnp.float32)
-    step = _dag_step(mesh, levels, rounds, max_len, salt, have_dist)
-    return step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg)
+    have_dst = dst_nodes is not None
+    if have_dst and dst_nodes.shape[0] % n_shards:
+        raise ValueError(
+            f"dst set T={dst_nodes.shape[0]} must divide by {n_shards} shards"
+        )
+    dst_arg = (
+        dst_nodes if have_dst else jnp.zeros((n_shards,), dtype=jnp.int32)
+    )
+    step = _dag_step(mesh, levels, rounds, max_len, salt, have_dist, have_dst)
+    return step(
+        adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg,
+        dst_arg,
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _dag_step(
     mesh: Mesh, levels: int, rounds: int, max_len: int, salt: int,
-    have_dist: bool,
+    have_dist: bool, have_dst: bool = False,
 ):
     """Build (and cache) the jitted sharded DAG step for one config.
 
@@ -337,7 +357,8 @@ def _dag_step(
     hops = sampled_hops(max_len)
 
     @jax.jit
-    def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in):
+    def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in,
+             dst_nodes):
         v = adj.shape[0]
         base = (
             jnp.zeros((v, v), jnp.float32)
@@ -345,6 +366,14 @@ def _dag_step(
             .set(link_util, unique_indices=True, mode="drop")
         )
         d = dist_in if have_dist else apsp_distances_sharded(adj, mesh)
+        if have_dst:
+            # restrict the destination axis BEFORE sharding: each device
+            # then owns a T/n_shards block of the compact rows
+            from sdnmpi_tpu.oracle.dag import restrict_dst
+
+            d_t, traffic = restrict_dst(d, traffic, dst_nodes)
+        else:
+            d_t = d.T
 
         @functools.partial(
             shard_map,
@@ -357,11 +386,12 @@ def _dag_step(
                 P(("flow", "v"), None),  # traffic T block
                 P(("flow", "v")),  # src slice
                 P(("flow", "v")),  # dst slice
+                P(None),  # dst set (replicated: samplers match on it)
             ),
             out_specs=(P(("flow", "v"), None), P(None, None)),
             check_vma=False,  # psum-derived outputs are replicated
         )
-        def inner(a, d_full, d_t_local, base, traffic_local, s, t):
+        def inner(a, d_full, d_t_local, base, traffic_local, s, t, dn):
             adj_f = (a > 0).astype(jnp.float32)
             weights = congestion_weights(adj_f, base)
             load = lax.psum(
@@ -381,11 +411,12 @@ def _dag_step(
             )
             fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
             _, slots = sample_paths_dense(
-                weights, d_full, s, t, hops, salt=salt, fid_base=fid_base
+                weights, d_full, s, t, hops, salt=salt, fid_base=fid_base,
+                dst_nodes=dn if have_dst else None,
             )
             return slots, maxc[None, None]
 
-        slots, maxc = inner(adj, d, d.T, base, traffic, src, dst)
+        slots, maxc = inner(adj, d, d_t, base, traffic, src, dst, dst_nodes)
         return slots, maxc[0, 0]
 
     return step

@@ -92,6 +92,35 @@ def test_sharded_dag_matches_single_device():
     _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
 
 
+def test_sharded_dag_dst_restricted_matches_full():
+    """dst_nodes on the sharded path: each device owns a block of the
+    compact [T, V] destination rows; slots stay bit-identical to the
+    unrestricted single-device engine."""
+    from sdnmpi_tpu.oracle.dag import make_dst_nodes
+
+    mesh = make_mesh(N_SHARDS)
+    t, adj_host, src, dst, traffic, li, lj = _problem()
+    util = np.zeros(len(li), np.float32)
+
+    buf = route_collective(
+        t.adj, jnp.asarray(li), jnp.asarray(lj), jnp.asarray(util),
+        jnp.asarray(traffic), jnp.asarray(src), jnp.asarray(dst),
+        levels=MAX_LEN - 1, rounds=2, max_len=MAX_LEN,
+        max_degree=t.max_degree,
+    )
+    slots_1, maxc_1 = unpack_result(np.asarray(buf), len(src), MAX_LEN)
+
+    slots_s, maxc_s = route_collective_sharded(
+        t.adj, jnp.asarray(li), jnp.asarray(lj), jnp.asarray(util),
+        jnp.asarray(traffic), jnp.asarray(src), jnp.asarray(dst), mesh,
+        levels=MAX_LEN - 1, rounds=2, max_len=MAX_LEN,
+        dst_nodes=jnp.asarray(make_dst_nodes(dst)),
+    )
+    np.testing.assert_array_equal(np.asarray(slots_s), slots_1)
+    np.testing.assert_allclose(float(maxc_s), maxc_1, rtol=1e-5)
+    _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
+
+
 def test_sharded_dag_under_utilization():
     """Measured link utilization steers the sharded balancer the same
     way as the single-device one: paths stay valid, the psum-ed
