@@ -15,9 +15,10 @@ of each input strip plus a single packed int32 write per flow (all
 sampled slots byte-packed into one word). Same hash chain and argmax
 ordering as the XLA sampler, so interpret mode matches it exactly.
 
-Supports up to 4 sampled hops per flow (4 slot bytes per int32 word) —
-with forced-final-hop elision (oracle/dag.sampled_hops) that covers
-every topology of diameter <= 5; larger diameters fall back to the XLA
+Supports up to 8 sampled hops per flow (4 slot bytes per int32 word,
+two words when hops > 4) — with forced-final-hop elision
+(oracle/dag.sampled_hops) that covers every topology of diameter <= 9,
+including 3D tori up to 6x6x6; larger diameters fall back to the XLA
 sampler.
 """
 
@@ -83,14 +84,15 @@ def sampler_supported(
         platform = jax.default_backend()
     if platform != "tpu":
         return False
-    if v % 128 != 0 or not (1 <= hops <= 4):
+    if v % 128 != 0 or not (1 <= hops <= 8):
         return False
     t = t_dst or 0
     if t % 128 != 0:
         return False
     block = _pick_block(v, t)
     f_pad = ((n_flows + block - 1) // block) * block
-    n_full = 3 if t_dst is None else 4  # src, dst, [dslot,] out
+    # src, dst, [dslot,] out (out doubles beyond 4 hops: two packed words)
+    n_full = (3 if t_dst is None else 4) + (1 if hops > 4 else 0)
     # lw [V, V] bf16 [+ d2e [T, V] bf16] + ~8 strips of [B, V] bf16/f32
     # at the chosen block + the [F_pad] int32 full-array blocks, against
     # the hard limit
@@ -173,8 +175,7 @@ def _sampler_kernel(*refs, hops: int, salt: int, block: int, dstset: bool):
         alive0 &= slot_d >= 0
     node0 = jnp.where(alive0, src, -1)
 
-    def hop(h, carry):
-        node, packed = carry
+    def hop(h, node, packed_lo, packed_hi):
         moving = (node >= 0) & (node != dst)  # [B, 1]
         oh = (iota_v == jnp.maximum(node, 0)).astype(jnp.bfloat16)
         # [B, V] log w out of node (MXU), reading lw in column slices
@@ -220,14 +221,24 @@ def _sampler_kernel(*refs, hops: int, salt: int, block: int, dstset: bool):
         ok = moving & has
         nxt = jnp.where(ok, nxt, -1)
         slot = jnp.where(ok, slot, -1)
-        # byte-pack: slot byte h of the word (0xFF encodes -1)
+        # byte-pack: slot byte h%4 of word h//4 (0xFF encodes -1).
+        # Shift amounts are clamped to the int32 range; the jnp.where
+        # masks route the byte to exactly one word.
         byte = jnp.where(slot >= 0, slot, 255).astype(jnp.int32) & 255
-        packed = packed | (byte << (8 * h))
-        return nxt, packed
+        lo = packed_lo | jnp.where(h < 4, byte << (8 * jnp.minimum(h, 3)), 0)
+        hi = packed_hi | jnp.where(
+            h >= 4, byte << (8 * jnp.maximum(h - 4, 0)), 0
+        )
+        return nxt, lo, hi
 
-    packed0 = jnp.zeros((block, 1), jnp.int32)
-    _, packed = jax.lax.fori_loop(0, hops, hop, (node0, packed0))
-    out_ref[pl.ds(i, 1), :] = packed.reshape(1, block)
+    zeros = jnp.zeros((block, 1), jnp.int32)
+    _, packed_lo, packed_hi = jax.lax.fori_loop(
+        0, hops, lambda h, c: hop(h, c[0], c[1], c[2]), (node0, zeros, zeros)
+    )
+    out_ref[pl.ds(i, 1), :] = packed_lo.reshape(1, block)
+    if hops > 4:
+        nb = pl.num_programs(0)
+        out_ref[pl.ds(nb + i, 1), :] = packed_hi.reshape(1, block)
 
 
 @functools.partial(jax.jit, static_argnames=("hops", "salt", "interpret"))
@@ -311,16 +322,18 @@ def sample_slots_pallas(
             full(),
             full(),
         ]
+    n_words = 2 if hops > 4 else 1
     packed = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((nb, block), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((n_words * nb, block), jnp.int32),
         grid=(nb,),
         in_specs=in_specs,
-        out_specs=full(),
+        out_specs=vm((n_words * nb, block), lambda i: (0, 0)),
         interpret=interpret,
     )(*operands)
 
-    words = packed.reshape(f_pad)[:f]  # [F] int32
-    shifts = jnp.arange(hops, dtype=jnp.int32) * 8
-    bytes_ = (words[:, None] >> shifts[None, :]) & 255
+    # rows [0, nb) hold slot bytes 0-3, rows [nb, 2nb) bytes 4-7
+    words = packed.reshape(n_words, f_pad)[:, :f]  # [W, F] int32
+    shifts = jnp.arange(hops, dtype=jnp.int32)
+    bytes_ = (words[shifts // 4, :].T >> (8 * (shifts % 4))[None, :]) & 255
     return jnp.where(bytes_ == 255, -1, bytes_).astype(jnp.int8)

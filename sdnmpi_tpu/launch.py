@@ -28,7 +28,15 @@ import pathlib
 
 from sdnmpi_tpu.config import Config
 from sdnmpi_tpu.control.controller import Controller
-from sdnmpi_tpu.topogen import dragonfly, fattree, host_mac, linear, ring, torus2d
+from sdnmpi_tpu.topogen import (
+    dragonfly,
+    fattree,
+    host_mac,
+    linear,
+    ring,
+    torus,
+    torus2d,
+)
 
 log = logging.getLogger("launch")
 
@@ -45,7 +53,9 @@ def parse_topo(spec: str):
     if kind == "dragonfly":
         return dragonfly(*(nums or [4, 4]))
     if kind == "torus":
-        return torus2d(*(nums or [4, 4]))
+        nums = nums or [4, 4]
+        # 2 dims keep the historical torus2d naming; 3+ dims go N-d
+        return torus2d(*nums) if len(nums) == 2 else torus(tuple(nums))
     raise SystemExit(f"unknown topology {spec!r}")
 
 

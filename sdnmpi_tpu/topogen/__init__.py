@@ -2,3 +2,4 @@ from sdnmpi_tpu.topogen.spec import TopoSpec, host_mac  # noqa: F401
 from sdnmpi_tpu.topogen.basic import linear, ring, torus2d, random_regular  # noqa: F401
 from sdnmpi_tpu.topogen.fattree import fattree  # noqa: F401
 from sdnmpi_tpu.topogen.dragonfly import dragonfly  # noqa: F401
+from sdnmpi_tpu.topogen.torus import torus  # noqa: F401

@@ -42,33 +42,16 @@ def ring(n_switches: int, hosts_per_switch: int = 1) -> TopoSpec:
 
 
 def torus2d(nx: int, ny: int, hosts_per_switch: int = 1) -> TopoSpec:
-    """2D torus with wraparound in both dimensions."""
-    ports = PortAllocator()
+    """2D torus — the (y, x)-coordinate special case of
+    :func:`sdnmpi_tpu.topogen.torus.torus` (same dpid numbering:
+    ``1 + y*nx + x``), kept as the stable 2-argument CLI/API form.
+    One generator owns the wraparound/size-2 dedup logic."""
+    import dataclasses
 
-    def dpid(x: int, y: int) -> int:
-        return y * nx + x + 1
+    from sdnmpi_tpu.topogen.torus import torus
 
-    switches = [dpid(x, y) for y in range(ny) for x in range(nx)]
-    hosts = []
-    host_id = 0
-    for s in switches:
-        for _ in range(hosts_per_switch):
-            hosts.append((host_mac(host_id), s, ports.take(s)))
-            host_id += 1
-    links = []
-    for y in range(ny):
-        for x in range(nx):
-            a = dpid(x, y)
-            right = dpid((x + 1) % nx, y)
-            down = dpid(x, (y + 1) % ny)
-            # for a dimension of size 2 the wraparound would duplicate the
-            # neighbor cable (TopologyDB keys links by switch pair, so a
-            # second parallel cable is silently collapsed)
-            if nx > 1 and not (nx == 2 and x == 1):
-                links.append((a, ports.take(a), right, ports.take(right)))
-            if ny > 1 and not (ny == 2 and y == 1):
-                links.append((a, ports.take(a), down, ports.take(down)))
-    return TopoSpec(f"torus-{nx}x{ny}", switches, links, hosts)
+    spec = torus((ny, nx), hosts_per_switch)
+    return dataclasses.replace(spec, name=f"torus-{nx}x{ny}")
 
 
 def random_regular(

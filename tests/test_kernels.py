@@ -79,7 +79,7 @@ class TestSamplerKernel:
         dst = jnp.asarray(rng.integers(0, t.n_real, f).astype(np.int32))
         return t, dist, weights, src, dst
 
-    @pytest.mark.parametrize("hops", [1, 2, 3, 4])
+    @pytest.mark.parametrize("hops", [1, 2, 3, 4, 5, 6, 8])
     def test_bit_parity_with_xla_sampler(self, problem, hops):
         from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
         from sdnmpi_tpu.oracle.dag import sample_paths_dense
@@ -95,7 +95,7 @@ class TestSamplerKernel:
         from sdnmpi_tpu.kernels.sampler import sampler_supported
 
         assert not sampler_supported(1000, 3)  # not lane-aligned
-        assert not sampler_supported(1024, 5)  # > 4 packable hops
+        assert not sampler_supported(1024, 9)  # > 8 packable hops
         assert not sampler_supported(1024, 0)
         assert not sampler_supported(1024, 3, platform="cpu")
 

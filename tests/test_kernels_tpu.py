@@ -73,6 +73,31 @@ def test_sampler_kernel_matches_xla(v):
     np.testing.assert_array_equal(sp, np.asarray(sd))
 
 
+def test_sampler_two_word_packing_matches_xla():
+    """hops > 4 engages the second packed output word on real Mosaic —
+    torus-class diameters (3D torus 4x4x4 needs 5 sampled hops)."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import congestion_weights, sample_paths_dense
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.topogen import torus
+
+    hops = 6
+    db = torus((4, 4, 4)).to_topology_db(backend="jax", pad_multiple=128)
+    t = tensorize(db, pad_multiple=128)
+    v = t.adj.shape[0]
+    assert sampler_supported(v, hops, n_flows=2048)
+    rng = np.random.default_rng(6)
+    cost = jnp.asarray(rng.uniform(0, 4, (v, v)).astype(np.float32)) * t.adj
+    weights = congestion_weights((t.adj > 0).astype(jnp.float32), cost)
+    dist = apsp_distances(t.adj)
+    src = jnp.asarray(rng.integers(0, t.n_real, 2048).astype(np.int32))
+    dst = jnp.asarray(rng.integers(0, t.n_real, 2048).astype(np.int32))
+    sp = np.asarray(sample_slots_pallas(weights, dist, src, dst, hops, salt=31))
+    _, sd = sample_paths_dense(weights, dist, src, dst, hops, salt=31)
+    np.testing.assert_array_equal(sp, np.asarray(sd))
+
+
 @pytest.mark.parametrize("v", [1024, 1280])
 def test_sampler_dstset_kernel_matches_xla(v):
     """Destination-set kernel layout on real Mosaic: compact [T, V] d2e
