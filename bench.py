@@ -181,28 +181,16 @@ def main() -> None:
 
     # validation + context (untimed): decode every route, recompute the
     # exact discrete link loads, compare against naive single-path routing
+    from benchmarks.common import naive_single_path_load
+    from sdnmpi_tpu.oracle.adaptive import link_loads
+
     nodes = slots_to_nodes(np.asarray(t.adj), src, slots0, dst, complete=True)
     ok = nodes[:, 0] == src
     assert ok.all(), "every aggregated flow must start at its source"
-    load = np.zeros((v, v), np.float32)
-    for h in range(max_len - 1):
-        a, b = nodes[:, h], nodes[:, h + 1]
-        sel = (a >= 0) & (b >= 0)
-        np.add.at(load, (a[sel], b[sel]), weight[sel])
-    discrete_max = float(load.max())
-
-    from sdnmpi_tpu.oracle.apsp import apsp_next_hops
-    from sdnmpi_tpu.oracle.paths import batch_paths
-
-    nxt = apsp_next_hops(t.adj, dist_d)
-    naive_nodes, _ = batch_paths(nxt, src_d, dst_d, max_len)
-    naive_nodes = np.asarray(naive_nodes)
-    naive_load = np.zeros((v, v), np.float32)
-    for h in range(max_len - 1):
-        a, b = naive_nodes[:, h], naive_nodes[:, h + 1]
-        sel = (a >= 0) & (b >= 0)
-        np.add.at(naive_load, (a[sel], b[sel]), weight[sel])
-    naive_max = float(naive_load.max())
+    discrete_max = float(link_loads(nodes, weight, v).max())
+    naive_max = float(
+        naive_single_path_load(t.adj, dist_d, src, dst, weight, max_len, v).max()
+    )
     log(
         f"max link congestion: balanced {discrete_max:,.0f} discrete "
         f"(fractional bound {np.median([maxc0] + congs):,.0f}) vs "

@@ -40,6 +40,64 @@ def time_fn(fn, warmup: int = 2, iters: int = 10) -> float:
     return float(np.median(samples))
 
 
+def alltoall_problem(spec, t, n_ranks: int):
+    """Aggregated alltoall over the spec's first ``n_ranks`` hosts.
+
+    One flow per ordered pair of distinct host-bearing switches, weight
+    = ranks_on_src x ranks_on_dst (computed analytically — no N^2 pair
+    expansion), lexicographic over sorted switch indices (np.unique
+    order, matching aggregate_pairs' output order). Returns
+    ``(usrc, udst, weight, n_rank_pairs)``.
+    """
+    host_edge = np.array(
+        [t.index[dpid] for _, dpid, _ in spec.hosts[:n_ranks]], np.int32
+    )
+    edges, counts = np.unique(host_edge, return_counts=True)
+    ga, gb = np.meshgrid(edges, edges, indexing="ij")
+    wa, wb = np.meshgrid(counts, counts, indexing="ij")
+    off = ga != gb
+    usrc = ga[off].astype(np.int32)
+    udst = gb[off].astype(np.int32)
+    weight = (wa[off] * wb[off]).astype(np.float32)
+    return usrc, udst, weight, n_ranks * n_ranks - int((counts**2).sum())
+
+
+def measure_route(route_fn, n_stream: int = 10):
+    """Compile + warm ``route_fn`` (device-buffer thunk), then measure a
+    pipelined dispatch/fetch stream. Returns ``(ms_per_item,
+    first_buffer_host)`` — the shared protocol of the route-latency
+    configs."""
+    first = np.asarray(route_fn())
+    np.asarray(route_fn())
+
+    def dispatch_fetch(i):
+        b = route_fn()
+        try:
+            b.copy_to_host_async()
+        except Exception:
+            pass
+        return np.asarray(b)
+
+    ms, _, _ = stream_throughput(dispatch_fetch, n_stream=n_stream)
+    return ms, first
+
+
+def naive_single_path_load(adj_dev, dist_dev, usrc, udst, weight, max_len, v):
+    """Max-link congestion of deterministic single-path routing — the
+    vs_baseline denominator shared by the alltoall configs."""
+    import jax
+
+    from sdnmpi_tpu.oracle.adaptive import link_loads
+    from sdnmpi_tpu.oracle.apsp import apsp_next_hops
+    from sdnmpi_tpu.oracle.paths import batch_paths
+
+    nxt = apsp_next_hops(adj_dev, dist_dev)
+    naive, _ = batch_paths(
+        nxt, jax.device_put(usrc), jax.device_put(udst), max_len
+    )
+    return link_loads(np.asarray(naive), weight, v)
+
+
 def place_ranks(db, n_ranks: int) -> dict[int, str]:
     """rank -> host MAC, block placement over sorted host MACs."""
     macs = sorted(db.hosts)

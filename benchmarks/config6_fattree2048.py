@@ -25,12 +25,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from benchmarks.common import emit, log, stream_throughput
+from benchmarks.common import (
+    alltoall_problem,
+    emit,
+    log,
+    measure_route,
+    naive_single_path_load,
+)
 from sdnmpi_tpu.oracle.adaptive import link_loads
-from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
+from sdnmpi_tpu.oracle.apsp import apsp_distances
 from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
 from sdnmpi_tpu.oracle.engine import tensorize
-from sdnmpi_tpu.oracle.paths import batch_paths
 from sdnmpi_tpu.topogen import fattree
 
 N_RANKS = 8192
@@ -47,20 +52,7 @@ def _build(pad_multiple: int):
     v = t.adj.shape[0]
     adj = np.asarray(t.adj)
 
-    host_edge = np.array(
-        [t.index[dpid] for _, dpid, _ in spec.hosts[:N_RANKS]], np.int32
-    )
-    # aggregate analytically: an alltoall's (src_edge, dst_edge) weight is
-    # ranks_on_src_edge x ranks_on_dst_edge — no need to materialize the
-    # 67M-pair expansion that aggregate_pairs would count (same output
-    # order: lexicographic over sorted edge ids)
-    edges, counts = np.unique(host_edge, return_counts=True)
-    ga, gb = np.meshgrid(edges, edges, indexing="ij")
-    wa, wb = np.meshgrid(counts, counts, indexing="ij")
-    off = ga != gb
-    usrc = ga[off].astype(np.int32)
-    udst = gb[off].astype(np.int32)
-    weight = (wa[off] * wb[off]).astype(np.float32)
+    usrc, udst, weight, n_rank_pairs = alltoall_problem(spec, t, N_RANKS)
 
     # destination set: the edge switches, -1 padded to a lane multiple
     from sdnmpi_tpu.oracle.dag import make_dst_nodes
@@ -85,23 +77,8 @@ def _build(pad_multiple: int):
     kw = dict(levels=levels, rounds=2, max_len=levels + 1,
               max_degree=t.max_degree, dist=dist_d,
               dst_nodes=jax.device_put(jax.numpy.asarray(dst_nodes)))
-    n_rank_pairs = N_RANKS * N_RANKS - int((counts**2).sum())
-    return spec, t, args, kw, usrc, udst, weight, len(edges), n_rank_pairs
-
-
-def _measure(args, kw) -> float:
-    def dispatch_fetch(i):
-        b = route_collective(*args, **kw)
-        try:
-            b.copy_to_host_async()
-        except Exception:
-            pass
-        return np.asarray(b)
-
-    np.asarray(route_collective(*args, **kw))  # compile + warm
-    np.asarray(route_collective(*args, **kw))
-    t_ms, _, _ = stream_throughput(dispatch_fetch, n_stream=10)
-    return t_ms
+    n_edges = int((dst_nodes >= 0).sum())
+    return spec, t, args, kw, usrc, udst, weight, n_edges, n_rank_pairs
 
 
 def main() -> None:
@@ -118,19 +95,16 @@ def main() -> None:
     log(f"fast path: bfs={pallas_supported(v)} sampler="
         f"{sampler_supported(v, max_len - 2, n_flows=len(usrc), t_dst=t_dst)}")
 
-    t_route_ms = _measure(args, kw)
-    buf = np.asarray(route_collective(*args, **kw))
+    t_route_ms, buf = measure_route(lambda: route_collective(*args, **kw))
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     adj = np.asarray(t.adj)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
     assert (nodes[:, 0] == usrc).all()
     load = link_loads(nodes, weight, v)
 
-    import jax
-
-    nxt = apsp_next_hops(t.adj, kw["dist"])
-    naive, _ = batch_paths(nxt, jax.device_put(usrc), jax.device_put(udst), max_len)
-    naive_load = link_loads(np.asarray(naive), weight, v)
+    naive_load = naive_single_path_load(
+        t.adj, kw["dist"], usrc, udst, weight, max_len, v
+    )
     log(f"route {t_route_ms:.2f} ms; max congestion balanced "
         f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
     emit(
@@ -145,7 +119,7 @@ def main() -> None:
     log(f"ceiling demo: V padded {spec2.n_switches} -> {v2}, "
         f"bfs={pallas_supported(v2)} sampler="
         f"{sampler_supported(v2, kw2['max_len'] - 2, n_flows=len(usrc2), t_dst=kw2['dst_nodes'].shape[0])}")
-    t2_ms = _measure(args2, kw2)
+    t2_ms, _ = measure_route(lambda: route_collective(*args2, **kw2))
     log(f"ceiling demo route {t2_ms:.2f} ms at V={v2}")
     emit("alltoall8192_v2048pad_route_ms", t2_ms, "ms", t_route_ms / t2_ms)
 

@@ -1,0 +1,101 @@
+"""Config 7: 4096-rank MPI_Alltoall on a 6x6x6 torus (216 switches).
+
+Tori are the canonical interconnect of the hardware this framework
+targets (TPU pods are 2D/3D tori) and stress the oracle opposite to
+fat-trees: constant degree 6, diameter 9 (vs a fat-tree's 4), and huge
+equal-cost path diversity along dimension-ordered DAGs. Diameter 9 is
+exactly the new Pallas sampler ceiling (8 sampled hops packed across
+two int32 words, kernels/sampler.py), so this config pins the
+two-word fast path with a real measured number.
+
+Every switch serves 19 hosts (4104 >= 4096 ranks), so every switch is
+also a destination — the dst_nodes restriction cannot pay here
+(T == V) and the unrestricted engine runs; that asymmetry vs config 6
+is the point of having both shapes in the suite.
+
+Reported value: steady-state per-collective route latency (pipelined
+stream, like bench.py). vs_baseline: max-link congestion of naive
+deterministic single-path routing / the balanced routing's congestion.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from benchmarks.common import (
+    alltoall_problem,
+    emit,
+    log,
+    measure_route,
+    naive_single_path_load,
+)
+from sdnmpi_tpu.oracle.adaptive import link_loads
+from sdnmpi_tpu.oracle.apsp import apsp_distances
+from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
+from sdnmpi_tpu.oracle.engine import tensorize
+from sdnmpi_tpu.topogen import torus
+
+N_RANKS = 4096
+DIMS = (6, 6, 6)
+HOSTS_PER_SWITCH = 19  # 216 * 19 = 4104 >= 4096
+
+
+def main() -> None:
+    import jax
+
+    from sdnmpi_tpu.kernels.bfs import pallas_supported
+    from sdnmpi_tpu.kernels.sampler import sampler_supported
+
+    spec = torus(DIMS, hosts_per_switch=HOSTS_PER_SWITCH)
+    db = spec.to_topology_db(backend="jax", pad_multiple=128)
+    t = tensorize(db, pad_multiple=128)
+    v = t.adj.shape[0]
+    adj = np.asarray(t.adj)
+
+    usrc, udst, weight, n_rank_pairs = alltoall_problem(spec, t, N_RANKS)
+
+    dist_d = apsp_distances(t.adj)
+    dist_h = np.asarray(dist_d)
+    levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
+    max_len = levels + 1
+    li, lj = np.nonzero(adj > 0)
+    rng = np.random.default_rng(0)
+    util = (rng.random(len(li)) * 2e9).astype(np.float32)
+    traffic = np.zeros((v, v), np.float32)
+    traffic[udst, usrc] = weight
+
+    log(f"{spec.name}: {spec.n_switches} switches (padded {v}), "
+        f"{spec.n_hosts} hosts; alltoall {n_rank_pairs:,} rank pairs -> "
+        f"{len(usrc):,} switch-pair flows; diameter {levels}")
+    log(f"fast path: bfs={pallas_supported(v)} sampler="
+        f"{sampler_supported(v, max_len - 2, n_flows=len(usrc))} "
+        f"(two-word packing: hops={max_len - 2})")
+
+    args = [
+        t.adj, jax.device_put(li.astype(np.int32)),
+        jax.device_put(lj.astype(np.int32)), jax.device_put(util),
+        jax.device_put(traffic), jax.device_put(usrc), jax.device_put(udst),
+    ]
+    kw = dict(levels=levels, rounds=2, max_len=max_len,
+              max_degree=t.max_degree, dist=dist_d)
+
+    t_route_ms, buf = measure_route(lambda: route_collective(*args, **kw))
+
+    slots, maxc = unpack_result(buf, len(usrc), max_len)
+    nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
+    assert (nodes[:, 0] == usrc).all()
+    load = link_loads(nodes, weight, v)
+
+    naive_load = naive_single_path_load(
+        t.adj, dist_d, usrc, udst, weight, max_len, v
+    )
+    log(f"route {t_route_ms:.2f} ms; max congestion balanced "
+        f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
+    emit(
+        "alltoall4096_torus666_route_ms", t_route_ms, "ms",
+        naive_load.max() / max(load.max(), 1.0),
+    )
+
+
+if __name__ == "__main__":
+    main()

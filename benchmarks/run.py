@@ -1,8 +1,10 @@
-"""Run all five BASELINE.md benchmark configs; collect JSON lines.
+"""Run all BASELINE.md benchmark configs; collect JSON lines.
 
 Each config runs in a subprocess (fresh XLA client, honest compile
 boundaries). Config 4 is the repo-root ``bench.py`` flagship. Results
-land in ``BENCH_suite.json`` and on stdout (one line per config).
+land in ``BENCH_suite.json`` and on stdout (one line per config; a
+config that emits several JSON lines — e.g. config 6's primary +
+ceiling-demo pair — contributes them all, suffixed 6, 6b, ...).
 """
 
 from __future__ import annotations
@@ -19,13 +21,20 @@ CONFIGS = [
     ("4", [sys.executable, "bench.py"]),
     ("5", [sys.executable, "-m", "benchmarks.config5_dragonfly"]),
     ("6", [sys.executable, "-m", "benchmarks.config6_fattree2048"]),
+    ("7", [sys.executable, "-m", "benchmarks.config7_torus"]),
 ]
 
 
 def main() -> None:
     root = pathlib.Path(__file__).resolve().parent.parent
+    only = set(sys.argv[1:])  # e.g. `python -m benchmarks.run 4 6`
+    known = {name for name, _ in CONFIGS}
+    if unknown := only - known:
+        sys.exit(f"unknown config(s) {sorted(unknown)}; choose from {sorted(known)}")
     results = []
     for name, cmd in CONFIGS:
+        if only and name not in only:
+            continue
         print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(
@@ -36,17 +45,28 @@ def main() -> None:
             print(json.dumps(results[-1]), flush=True)
             continue
         sys.stderr.write(proc.stderr)
-        lines = proc.stdout.strip().splitlines()
+        lines = [
+            ln for ln in proc.stdout.strip().splitlines()
+            if ln.lstrip().startswith("{")
+        ]
         if proc.returncode != 0 or not lines:
             results.append(
                 {"config": name, "error": proc.returncode or "no output"}
             )
             print(json.dumps(results[-1]), flush=True)
             continue
-        rec = {"config": name, **json.loads(lines[-1])}
-        results.append(rec)
-        print(json.dumps(rec), flush=True)
-    (root / "BENCH_suite.json").write_text(json.dumps(results, indent=2) + "\n")
+        for i, ln in enumerate(lines):
+            suffix = "" if i == 0 else chr(ord("b") + i - 1)
+            try:
+                rec = {"config": f"{name}{suffix}", **json.loads(ln)}
+            except json.JSONDecodeError as e:
+                rec = {"config": f"{name}{suffix}", "error": f"bad JSON: {e}"}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    if not only:  # partial runs must not clobber the full-suite record
+        (root / "BENCH_suite.json").write_text(
+            json.dumps(results, indent=2) + "\n"
+        )
     failed = [r for r in results if "error" in r]
     sys.exit(1 if failed else 0)
 
