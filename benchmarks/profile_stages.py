@@ -2,12 +2,14 @@
 
 Times each device stage of the flagship program in isolation — BFS
 distances, iterative DAG balancing, the destination-distance matmul,
-the path sampler — plus the fused end-to-end program, for any fat-tree
-size. This is the measurement tool behind the stage-cost model in
-oracle/dag.py: run it before and after kernel changes to see which
-stage actually moved.
+the path sampler — plus the fused end-to-end program, for any
+parse_topo topology (fat-tree, torus, dragonfly, ...). This is the
+measurement tool behind the stage-cost model in oracle/dag.py: run it
+before and after kernel changes to see which stage actually moved.
 
-Usage: python -m benchmarks.profile_stages [k] [pad_multiple]
+Usage: python -m benchmarks.profile_stages [topo] [pad_multiple]
+  topo: a launch.parse_topo spec ("fattree:32", "torus:6,6,6",
+        "dragonfly:8,32") or a bare fat-tree k for back-compat ("32")
 """
 
 from __future__ import annotations
@@ -21,7 +23,6 @@ from benchmarks.common import log
 from sdnmpi_tpu.oracle import dag
 from sdnmpi_tpu.oracle.apsp import apsp_distances
 from sdnmpi_tpu.oracle.engine import tensorize
-from sdnmpi_tpu.topogen import fattree
 
 
 def _time(fn, n=10, windows=3):
@@ -45,30 +46,25 @@ def _time(fn, n=10, windows=3):
     return float(np.median(per_item)), float(np.min(per_item))
 
 
-def main(k: int = 32, pad_multiple: int = 128) -> None:
+def main(topo: str = "fattree:32", pad_multiple: int = 128) -> None:
     import jax
     import jax.numpy as jnp
 
+    from benchmarks.common import alltoall_problem
     from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
     from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.launch import parse_topo
 
-    spec = fattree(k)
+    spec = parse_topo(f"fattree:{topo}" if topo.isdigit() else topo)
     db = spec.to_topology_db(backend="jax", pad_multiple=pad_multiple)
     t = tensorize(db, pad_multiple=pad_multiple)
     v = t.adj.shape[0]
     adj = np.asarray(t.adj)
-    log(f"fattree k={k}: {spec.n_switches} switches, padded V={v}")
+    log(f"{spec.name}: {spec.n_switches} switches, padded V={v}")
 
-    host_edge = np.array(
-        [t.index[dpid] for _, dpid, _ in spec.hosts], np.int32
-    )
-    edges, counts = np.unique(host_edge, return_counts=True)
-    ga, gb = np.meshgrid(edges, edges, indexing="ij")
-    wa, wb = np.meshgrid(counts, counts, indexing="ij")
-    off = ga != gb
-    usrc = jax.device_put(ga[off].astype(np.int32))
-    udst = jax.device_put(gb[off].astype(np.int32))
-    weight = (wa[off] * wb[off]).astype(np.float32)
+    usrc_h, udst_h, weight, _ = alltoall_problem(spec, t, spec.n_hosts)
+    usrc = jax.device_put(usrc_h)
+    udst = jax.device_put(udst_h)
     f = int(usrc.shape[0])
 
     dist = apsp_distances(t.adj)
@@ -86,7 +82,7 @@ def main(k: int = 32, pad_multiple: int = 128) -> None:
     )
     li, lj = jax.device_put(li), jax.device_put(lj)
     traffic = np.zeros((v, v), np.float32)
-    traffic[np.asarray(udst), np.asarray(usrc)] = weight
+    traffic[udst_h, usrc_h] = weight
     traffic = jax.device_put(traffic)
 
     # -- stage: BFS distances ------------------------------------------
@@ -178,6 +174,6 @@ def main(k: int = 32, pad_multiple: int = 128) -> None:
 
 
 if __name__ == "__main__":
-    k = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    topo = sys.argv[1] if len(sys.argv) > 1 else "fattree:32"
     pad = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    main(k, pad)
+    main(topo, pad)
