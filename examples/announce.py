@@ -1,0 +1,56 @@
+"""MPI-runtime-side announcement sender (the out-of-tree half of C8).
+
+The reference assumes a modified MPI runtime that broadcasts an 8-byte
+LAUNCH/EXIT packet on UDP:61000 when a rank starts or stops (receiving
+ABI: sdnmpi/protocol/announcement.py:3-18, flow install:
+sdnmpi/process.py:61-79); the sender itself was never in the tree.
+This example is that sender — what an MPI launcher shim would call —
+and doubles as executable documentation of the wire ABI:
+
+    python examples/announce.py launch 3          # rank 3 started
+    python examples/announce.py exit 3            # rank 3 exited
+    python examples/announce.py launch 0 --dest 10.0.0.255
+
+Against the real controller the packet must traverse a switch that has
+the UDP:61000 -> controller flow installed; the simulated fabric's demo
+path injects the same bytes via Fabric.inject_announcement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+
+# repo root for direct `python examples/announce.py` runs
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("type", choices=["launch", "exit"])
+    p.add_argument("rank", type=int)
+    p.add_argument("--dest", default="255.255.255.255",
+                   help="broadcast/unicast destination IP")
+    p.add_argument("--port", type=int, default=61000)
+    args = p.parse_args()
+
+    ann = Announcement(
+        AnnouncementType.LAUNCH if args.type == "launch"
+        else AnnouncementType.EXIT,
+        args.rank,
+    )
+    payload = ann.encode()
+    assert len(payload) == 8
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
+    s.sendto(payload, (args.dest, args.port))
+    print(f"sent {args.type.upper()} rank={args.rank} "
+          f"({payload.hex()}) to {args.dest}:{args.port}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Minimal visualizer-style client for the WebSocket state mirror.
+
+Connects to the controller's JSON-RPC feed (the same northbound surface
+the reference exposed to its visualizer at /v1.0/sdnmpi/ws, reference:
+sdnmpi/rpc_interface.py:98-110) and prints every notification: the
+three snapshot calls pushed on connect (init_fdb / init_rankdb /
+init_topologydb, rpc_interface.py:36-40) followed by incremental state
+changes (add_switch, add_link, add_process, update_fdb, ...).
+
+Run a controller with the mirror enabled, then this client:
+
+    python -m sdnmpi_tpu --topo fattree:4 --demo --duration 30 &
+    python examples/ws_client.py              # default 127.0.0.1:8080
+
+The feed is JSON-RPC 2.0 notifications, one per WebSocket message —
+any stock client library works; nothing here imports the framework.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+
+async def main(host: str = "127.0.0.1", port: int = 8080) -> None:
+    import websockets
+
+    uri = f"ws://{host}:{port}/v1.0/sdnmpi/ws"
+    async with websockets.connect(uri) as ws:
+        print(f"connected to {uri}", file=sys.stderr)
+        async for raw in ws:
+            msg = json.loads(raw)
+            method = msg.get("method", "?")
+            params = msg.get("params")
+            body = json.dumps(params)
+            if len(body) > 120:
+                body = body[:117] + "..."
+            print(f"{method:18s} {body}")
+
+
+if __name__ == "__main__":
+    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 8080
+    try:
+        asyncio.run(main(host, port))
+    except KeyboardInterrupt:
+        pass

@@ -77,6 +77,32 @@ def test_sample_paths_dense_restricted_parity(problem):
     np.testing.assert_array_equal(np.asarray(sf), np.asarray(sr))
 
 
+def test_pallas_dstset_two_word_parity():
+    """dst-set layout combined with >4-hop two-word packing: both kernel
+    variants' write paths in one program (torus diameter needs it)."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
+    from sdnmpi_tpu.topogen import torus
+
+    spec = torus((4, 4, 4))
+    db = spec.to_topology_db(backend="jax", pad_multiple=128)
+    t = tensorize(db, pad_multiple=128)
+    v = t.adj.shape[0]
+    dist = apsp_distances(t.adj)
+    rng = np.random.default_rng(13)
+    members = rng.choice(t.n_real, 48, replace=False).astype(np.int32)
+    dn = dag.make_dst_nodes(members)
+    src = jnp.asarray(rng.integers(0, t.n_real, 300).astype(np.int32))
+    dst = jnp.asarray(rng.choice(members, 300).astype(np.int32))
+    w = dag.congestion_weights(
+        (t.adj > 0).astype(jnp.float32), jnp.zeros((v, v))
+    )
+    _, ref = dag.sample_paths_dense(w, dist, src, dst, 6, salt=5)
+    got = sample_slots_pallas(
+        w, dist, src, dst, 6, salt=5, interpret=True, dst_nodes=jnp.asarray(dn)
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
 @pytest.mark.parametrize("hops", [1, 2, 3])
 def test_pallas_dstset_kernel_parity(problem, hops):
     """Interpret-mode destination-set kernel == XLA sampler, bit for bit,
