@@ -292,6 +292,44 @@ class TestEngineAdaptive:
         for fdb in fdbs:
             assert fdb  # every pair still routed
 
+    def test_adaptive_on_torus_detours_around_hot_dimension(self):
+        """UGAL on the N-d torus family: saturating every +x ring link at
+        one plane makes minimal routes expensive; UGAL must detour some
+        flows while keeping every route structurally valid."""
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+        from sdnmpi_tpu.topogen import torus
+
+        spec = torus((4, 4), hosts_per_switch=1)
+        db = spec.to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        t = oracle.refresh(db)
+        adj = np.asarray(t.adj)
+        port = np.asarray(t.port)
+        # heat the +x ring of row 0 (dpids 1..4 wrap): all arcs between
+        # row-0 switches
+        row0 = {1, 2, 3, 4}
+        link_util = {}
+        for i, j in zip(*np.nonzero(adj > 0)):
+            if int(t.dpids[i]) in row0 and int(t.dpids[j]) in row0:
+                link_util[(int(t.dpids[i]), int(port[i, j]))] = 1e9
+        macs = sorted(db.hosts)
+        by_dpid = {db.hosts[m].port.dpid: m for m in macs}
+        # flows along the hot row: 1 -> 3 (2 minimal hops inside row 0)
+        pairs = [(by_dpid[1], by_dpid[3]), (by_dpid[2], by_dpid[4])]
+        fdbs, n_detours, maxc = oracle.routes_batch_adaptive(
+            db, pairs, link_util=link_util, ugal_candidates=8
+        )
+        assert maxc > 0
+        for (a, b), fdb in zip(pairs, fdbs):
+            assert fdb, f"{a}->{b} must still route"
+            for (d1, p1), (d2, _) in zip(fdb, fdb[1:]):
+                assert db.links[d1][d2].src.port_no == p1
+            assert fdb[-1][0] == db.hosts[b].port.dpid
+        # at least one flow leaves the saturated row (a detour or an
+        # off-row minimal alternative chosen by the balancer)
+        used = {d for fdb in fdbs for d, _ in fdb}
+        assert used - row0, f"all hops stayed in the hot row: {fdbs}"
+
     def test_adaptive_reports_installed_discrete_congestion(self):
         """max_congestion is the discrete load of the fdbs actually
         returned — a host recomputation from the reply must match it
