@@ -74,6 +74,16 @@ class EventPortAdd(Event):
 
 
 @dataclasses.dataclass
+class EventPortDelete(Event):
+    """A switch lost a port (cable pulled / admin down): the real
+    southbound maps OFPT_PORT_STATUS deletes here (Ryu's EventPortDelete
+    role). TopologyManager prunes every link riding the port."""
+
+    dpid: int
+    port_no: int
+
+
+@dataclasses.dataclass
 class EventLinkAdd(Event):
     link: Any
 

@@ -1,0 +1,295 @@
+"""Real OpenFlow 1.0 TCP southbound — physical/OVS switches over bytes.
+
+The reference inherited its transport from Ryu: switches dialed the
+controller's TCP port, Ryu ran the version/features handshake, and the
+apps saw datapath objects (reference: run_router.sh:2 `ryu-manager`;
+every `datapath.send_msg` in sdnmpi/router.py:62, monitor.py:60,
+process.py:79). This module is that transport, built directly on the
+byte codec (protocol/ofwire.py):
+
+- an asyncio TCP server on the standard OF port (6633);
+- per connection: Hello + FeaturesRequest, then a framed read loop
+  (``ofwire.peek_header`` lengths) dispatching Echo, FeaturesReply,
+  PacketIn, FlowRemoved, and port StatsReply;
+- the same app-facing surface as the simulated ``Fabric``
+  (``flow_mod`` / ``packet_out`` / ``port_stats`` /
+  ``flow_block_set`` / ``connected_dpids``) and the same bus events
+  (EventDatapathUp/Down, EventSwitchEnter/Leave, EventPacketIn,
+  EventFlowRemoved) — so the entire controller runs unchanged against
+  real switches; the Fabric remains the hermetic test double.
+
+Asynchrony note: ``port_stats`` is a synchronous pull in the app API
+(the Monitor differentiates counters at its own cadence). Over TCP it
+returns the switch's most recent StatsReply and fires off a fresh
+request — one sampling interval of lag, which the delta computation
+absorbs (the first interval is a baseline anyway, reference:
+sdnmpi/monitor.py:70-77).
+
+``flow_block_set`` (this framework's array-native collective install,
+no OF 1.0 equivalent) degrades to its per-row FlowMods on the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+
+import numpy as np
+
+from sdnmpi_tpu.control.events import (
+    EventDatapathDown,
+    EventDatapathUp,
+    EventFlowRemoved,
+    EventPacketIn,
+    EventPortAdd,
+    EventPortDelete,
+    EventSwitchEnter,
+    EventSwitchLeave,
+)
+from sdnmpi_tpu.core.topology_db import Port, Switch
+from sdnmpi_tpu.protocol import ofwire
+from sdnmpi_tpu.protocol import openflow as of
+
+log = logging.getLogger("OFSouthbound")
+
+OFP_TCP_PORT = 6633
+
+
+class OFSouthbound:
+    """OpenFlow 1.0 controller endpoint (see module docstring)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = OFP_TCP_PORT):
+        self.host = host
+        self.port = port
+        self.bus = None
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: dict[int, asyncio.StreamWriter] = {}
+        self._ports: dict[int, set[int]] = {}
+        self._stats: dict[int, list[of.PortStatsEntry]] = {}
+        self._cookie_flows: dict[int, list] = {}
+        self._xid = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def connect(self, bus) -> None:
+        """Bus attach; replay already-connected datapaths (none — real
+        switches connect over TCP after serve())."""
+        self.bus = bus
+
+    async def serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        log.info("OpenFlow southbound listening on %s:%s", *addr[:2])
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self._writers.values()):
+            w.close()
+        self._writers.clear()
+
+    @property
+    def bound_port(self) -> int:
+        """The actual listening port (after serve(); for port=0 tests)."""
+        return self._server.sockets[0].getsockname()[1]
+
+    def _next_xid(self) -> int:
+        self._xid += 1
+        return self._xid
+
+    # -- per-connection protocol ------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        dpid: int | None = None
+        writer.write(ofwire.encode_hello(self._next_xid()))
+        writer.write(ofwire.encode_features_request(self._next_xid()))
+        await writer.drain()
+        buf = b""
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                buf += data
+                while len(buf) >= 8:
+                    # version-tolerant framing: a peer's HELLO advertises
+                    # its HIGHEST version (OVS default: 1.3+) and the
+                    # sides settle on the minimum — 1.0 here. Only a
+                    # non-HELLO at a version we never negotiated is a
+                    # protocol error.
+                    version, msg_type, length, xid = struct.unpack_from(
+                        "!BBHI", buf
+                    )
+                    if version != ofwire.OFP_VERSION and (
+                        msg_type != ofwire.OFPT_HELLO
+                    ):
+                        raise ValueError(
+                            f"message type {msg_type} at unnegotiated "
+                            f"version 0x{version:02x}"
+                        )
+                    if len(buf) < length:
+                        break
+                    msg, buf = buf[:length], buf[length:]
+                    dpid = self._dispatch(msg_type, msg, xid, dpid, writer)
+                    await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except (ValueError, struct.error) as e:
+            # framing/version/truncation error: drop the switch
+            log.warning("protocol error from dpid=%s: %s", dpid, e)
+        finally:
+            if dpid is not None and self._writers.get(dpid) is writer:
+                del self._writers[dpid]
+                self._ports.pop(dpid, None)
+                self._stats.pop(dpid, None)
+                if self.bus is not None:
+                    self.bus.publish(EventDatapathDown(dpid))
+                    self.bus.publish(
+                        EventSwitchLeave(Switch.make(dpid, []))
+                    )
+                log.info("datapath %#x disconnected", dpid)
+            writer.close()
+
+    def _dispatch(self, msg_type: int, msg: bytes, xid: int,
+                  dpid: int | None, writer: asyncio.StreamWriter) -> int | None:
+        """Handle one framed message; returns the (possibly learned) dpid."""
+        if msg_type == ofwire.OFPT_HELLO:
+            return dpid
+        if msg_type == ofwire.OFPT_ECHO_REQUEST:
+            writer.write(ofwire.encode_echo_reply(msg[8:], xid))
+            return dpid
+        if msg_type == ofwire.OFPT_FEATURES_REPLY:
+            new_dpid, port_nos = ofwire.decode_features_reply(msg)
+            self._writers[new_dpid] = writer
+            self._ports[new_dpid] = set(port_nos)
+            if self.bus is not None:
+                self.bus.publish(EventDatapathUp(new_dpid))
+                self.bus.publish(EventSwitchEnter(Switch.make(
+                    new_dpid, [Port(new_dpid, p) for p in sorted(port_nos)]
+                )))
+            log.info("datapath %#x connected (%d ports)", new_dpid,
+                     len(port_nos))
+            return new_dpid
+        if dpid is None:
+            log.debug("pre-handshake message type %d ignored", msg_type)
+            return dpid
+        if msg_type == ofwire.OFPT_PORT_STATUS:
+            reason, port_no, state = ofwire.decode_port_status(msg)
+            ports = self._ports.setdefault(dpid, set())
+            dead = reason == ofwire.OFPPR_DELETE or (
+                reason == ofwire.OFPPR_MODIFY
+                and state & ofwire.OFPPS_LINK_DOWN
+            )
+            if dead:
+                ports.discard(port_no)
+                if self.bus is not None:
+                    # TopologyManager prunes the port's links AND drops
+                    # it from the Switch entity (broadcast edge-port math)
+                    self.bus.publish(EventPortDelete(dpid, port_no))
+            elif port_no not in ports:
+                # OFPPR_ADD, or a MODIFY back to link-up after a flap —
+                # either way the port (re)joins the inventory and
+                # EventPortAdd makes LLDP discovery reflood it
+                ports.add(port_no)
+                if self.bus is not None:
+                    self.bus.publish(EventPortAdd(Switch.make(
+                        dpid, [Port(dpid, p) for p in sorted(ports)]
+                    )))
+            return dpid
+        if msg_type == ofwire.OFPT_PACKET_IN:
+            pkt, in_port, buffer_id, _reason = ofwire.decode_packet_in(msg)
+            if self.bus is not None:
+                self.bus.publish(EventPacketIn(dpid, in_port, pkt, buffer_id))
+        elif msg_type == ofwire.OFPT_FLOW_REMOVED:
+            rec = ofwire.decode_flow_removed(msg)
+            if self.bus is not None:
+                self.bus.publish(EventFlowRemoved(
+                    dpid, rec["match"], rec["priority"], rec["reason"],
+                    float(rec["duration_sec"]), rec["packet_count"],
+                    rec["byte_count"],
+                ))
+        elif msg_type == ofwire.OFPT_STATS_REPLY:
+            self._stats[dpid] = ofwire.decode_port_stats_reply(msg)
+        else:
+            log.debug("unhandled message type %d from %#x", msg_type, dpid)
+        return dpid
+
+    # -- southbound API used by the apps (Fabric-compatible) ---------------
+
+    def _send(self, dpid: int, payload: bytes) -> None:
+        w = self._writers.get(dpid)
+        if w is None:  # datapath died between event and send
+            log.debug("send to unknown dpid %s dropped", dpid)
+            return
+        w.write(payload)  # drained by the connection's event loop
+
+    def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
+        self._send(dpid, ofwire.encode_flow_mod(mod, xid=self._next_xid()))
+
+    def packet_out(self, dpid: int, out: of.PacketOut) -> None:
+        self._send(dpid, ofwire.encode_packet_out(out, xid=self._next_xid()))
+
+    def port_stats(self, dpid: int) -> list[of.PortStatsEntry]:
+        """Last cached reply; kicks off the next request (one-interval
+        lag — see module docstring)."""
+        self._send(
+            dpid, ofwire.encode_port_stats_request(xid=self._next_xid())
+        )
+        return self._stats.get(dpid, [])
+
+    def connected_dpids(self) -> list[int]:
+        return sorted(self._writers)
+
+    def flow_block_set(self, block: of.FlowBlockSet) -> None:
+        """Array-native collective install, expanded to one exact-match
+        FlowMod per (member, hop) — the wire has no block equivalent.
+        Installed matches are recorded per cookie so
+        ``flow_blocks_delete`` can tear the collective down (OF 1.0 has
+        no cookie-based delete; that arrived in 1.1)."""
+        from sdnmpi_tpu.utils.mac import int_to_mac
+
+        hop_dpid = np.asarray(block.hop_dpid)
+        hop_port = np.asarray(block.hop_port)
+        hop_len = np.asarray(block.hop_len)
+        bounds = np.asarray(block.bounds)
+        srcs = np.asarray(block.src)
+        dsts = np.asarray(block.dst)
+        final_port = np.asarray(block.final_port)
+        rewrite = None if block.rewrite is None else np.asarray(block.rewrite)
+        installed = self._cookie_flows.setdefault(block.cookie, [])
+        for s in range(len(hop_len)):
+            n_hops = int(hop_len[s])
+            for m in range(int(bounds[s]), int(bounds[s + 1])):
+                match = of.Match(
+                    dl_src=int_to_mac(int(srcs[m])),
+                    dl_dst=int_to_mac(int(dsts[m])),
+                )
+                for h in range(n_hops):
+                    last = h == n_hops - 1
+                    actions: tuple[of.Action, ...]
+                    if last:
+                        out = of.ActionOutput(int(final_port[m]))
+                        actions = (
+                            (of.ActionSetDlDst(int_to_mac(int(rewrite[m]))), out)
+                            if rewrite is not None else (out,)
+                        )
+                    else:
+                        actions = (of.ActionOutput(int(hop_port[s, h])),)
+                    dpid = int(hop_dpid[s, h])
+                    self.flow_mod(dpid, of.FlowMod(
+                        match, actions, block.priority, cookie=block.cookie,
+                    ))
+                    installed.append((dpid, match, block.priority))
+
+    def flow_blocks_delete(self, cookie: int) -> None:
+        """Tear down a collective install: one OFPFC_DELETE per recorded
+        exact match (see flow_block_set)."""
+        for dpid, match, priority in self._cookie_flows.pop(cookie, []):
+            self.flow_mod(dpid, of.FlowMod(
+                match, (), priority, command=of.OFPFC_DELETE, cookie=cookie,
+            ))

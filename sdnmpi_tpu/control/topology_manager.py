@@ -65,6 +65,7 @@ class TopologyManager:
         bus.subscribe(ev.EventSwitchEnter, lambda e: self.topologydb.add_switch(e.switch))
         bus.subscribe(ev.EventPortAdd, lambda e: self.topologydb.add_switch(e.switch))
         bus.subscribe(ev.EventSwitchLeave, self._switch_leave)
+        bus.subscribe(ev.EventPortDelete, self._port_delete)
         bus.subscribe(ev.EventLinkAdd, self._link_add)
         bus.subscribe(ev.EventLinkDelete, self._link_delete)
         bus.subscribe(ev.EventHostAdd, lambda e: self.topologydb.add_host(e.host))
@@ -228,14 +229,55 @@ class TopologyManager:
         self._drop_util((link.src.dpid, link.src.port_no))
 
     def _switch_leave(self, event) -> None:
-        self.topologydb.delete_switch(event.switch)
         dpid = event.switch.dp.id
+        # a southbound that only reports the disconnect (a real OF
+        # channel drop, control/southbound.py) leaves the dead switch's
+        # links in the DB — prune them through the normal delete events
+        # so the RPC mirror and flow revalidation fire. The simulated
+        # fabric already published these (control/fabric.py
+        # remove_switch), in which case nothing is left to prune.
+        self._prune_links(
+            lambda link: dpid in (link.src.dpid, link.dst.dpid)
+        )
+        self.topologydb.delete_switch(event.switch)
         for key in [k for k in self.link_util if k[0] == dpid]:
             self._drop_util(key)
         self._link_rev = {
             d: s for d, s in self._link_rev.items()
             if d[0] != dpid and s[0] != dpid
         }
+
+    def _port_delete(self, event) -> None:
+        """A port died (real southbound's OFPT_PORT_STATUS delete /
+        link-down): prune every link riding it, and drop it from the
+        switch's port set — a dead port with no links would otherwise
+        read as an edge port and receive every broadcast."""
+        key = (event.dpid, event.port_no)
+        self._prune_links(
+            lambda link: (link.src.dpid, link.src.port_no) == key
+            or (link.dst.dpid, link.dst.port_no) == key
+        )
+        self._drop_util(key)
+        sw = self.topologydb.switches.get(event.dpid)
+        if sw is not None:
+            from sdnmpi_tpu.core.topology_db import Switch
+
+            self.topologydb.add_switch(Switch.make(
+                event.dpid,
+                [p for p in sw.ports if p.port_no != event.port_no],
+            ))
+
+    def _prune_links(self, dead) -> None:
+        stale = [
+            link
+            for dst_map in self.topologydb.links.values()
+            for link in dst_map.values()
+            if dead(link)
+        ]
+        for link in stale:
+            self.bus.publish(ev.EventLinkDelete(link))
+        if stale:
+            self.bus.publish(ev.EventTopologyChanged())
 
     def _drop_util(self, key: tuple[int, int]) -> None:
         self.link_util.pop(key, None)

@@ -103,8 +103,13 @@ def run_demo(controller: Controller, fabric, n_ranks: int) -> None:
     log.info("demo: %d ranks, alltoall kicked off, %d flows installed", n, flows)
 
 
-async def amain(args) -> None:
-    config = Config(
+def config_from_args(args) -> Config:
+    listen = getattr(args, "listen", None)
+    if listen and not args.observe_links:
+        # LLDP discovery is the ONLY link/host source in real-switch
+        # mode (the simulated fabric's direct announcements don't exist)
+        log.info("--listen implies --observe-links; enabling discovery")
+    return Config(
         oracle_backend=args.backend,
         enable_monitor=args.profile != "no-monitor",
         rpc_host=args.rpc_host,
@@ -112,21 +117,38 @@ async def amain(args) -> None:
         collective_policy=args.policy,
         trace_log=args.trace_log or "",
         profile_dir=args.profile_dir or "",
-        observe_links=args.observe_links,
+        observe_links=args.observe_links or bool(listen),
         flow_idle_timeout=args.flow_idle_timeout,
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
         event_log=args.event_log or "",
     )
+
+
+async def amain(args) -> None:
+    listen = getattr(args, "listen", None)
+    config = config_from_args(args)
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
 
         set_trace_sink(config.trace_log)
-    spec = parse_topo(args.topo)
-    fabric = spec.to_fabric(
-        wire=args.wire,
-        discovery="packet" if args.observe_links else "direct",
-    )
+    if listen:
+        # real-switch mode: the southbound is an OpenFlow 1.0 TCP server
+        # (control/southbound.py) and the topology is whatever dials in —
+        # the posture the reference got from `ryu-manager` (run_router.sh)
+        if args.demo:
+            raise SystemExit("--demo needs the simulated fabric (no --listen)")
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        host, _, port = listen.rpartition(":")
+        fabric = OFSouthbound(host or "0.0.0.0", int(port))
+        spec = None
+    else:
+        spec = parse_topo(args.topo)
+        fabric = spec.to_fabric(
+            wire=args.wire,
+            discovery="packet" if args.observe_links else "direct",
+        )
     controller = Controller(fabric, config)
     controller.attach()
 
@@ -135,27 +157,31 @@ async def amain(args) -> None:
 
         load_checkpoint(controller, args.restore)
         log.info("restored checkpoint from %s", args.restore)
-    log.info(
-        "topology %s: %d switches, %d hosts",
-        spec.name,
-        spec.n_switches,
-        spec.n_hosts,
-    )
+    if spec is not None:
+        log.info(
+            "topology %s: %d switches, %d hosts",
+            spec.name,
+            spec.n_switches,
+            spec.n_hosts,
+        )
 
     tasks = []
     if controller.monitor is not None:
         tasks.append(asyncio.create_task(controller.monitor.run()))
 
-    async def clock() -> None:
-        # drive the fabric's flow-expiry clock (a real switch ages its
-        # own flows; the sim needs the tick) — cheap no-op while all
-        # installed flows are permanent (the default timeouts)
-        loop = asyncio.get_running_loop()
-        while True:
-            fabric.tick(loop.time())
-            await asyncio.sleep(1.0)
+    if spec is None:
+        await fabric.serve()  # accept real OF 1.0 switches
+    else:
+        async def clock() -> None:
+            # drive the fabric's flow-expiry clock (a real switch ages
+            # its own flows; the sim needs the tick) — cheap no-op while
+            # all installed flows are permanent (the default timeouts)
+            loop = asyncio.get_running_loop()
+            while True:
+                fabric.tick(loop.time())
+                await asyncio.sleep(1.0)
 
-    tasks.append(asyncio.create_task(clock()))
+        tasks.append(asyncio.create_task(clock()))
     if not args.no_rpc:
         from sdnmpi_tpu.api.rpc import RPCInterface
 
@@ -189,6 +215,8 @@ async def amain(args) -> None:
                 controller.event_logger.n_events, config.event_log,
             )
             controller.event_logger.close()
+        if spec is None:
+            await fabric.close()  # stop accepting real switches
         for task in tasks:
             task.cancel()
 
@@ -204,6 +232,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="launch profile (mirrors the reference's run_router*.sh)",
     )
     parser.add_argument("--topo", default="linear:4", help="topology spec, e.g. fattree:8")
+    parser.add_argument(
+        "--listen", default=None, metavar="[HOST:]PORT",
+        help="real-switch mode: serve OpenFlow 1.0 over TCP instead of "
+             "simulating --topo (e.g. --listen 6633); switches dial in "
+             "like they dialed the reference's ryu-manager",
+    )
     parser.add_argument("--backend", choices=["jax", "py"], default="jax")
     parser.add_argument("--rpc-host", default="127.0.0.1")
     parser.add_argument("--rpc-port", type=int, default=8080)

@@ -434,6 +434,83 @@ def decode_flow_removed(buf: bytes) -> dict:
     }
 
 
+OFPT_PORT_STATUS = 12
+OFPPR_ADD = 0
+OFPPR_DELETE = 1
+OFPPR_MODIFY = 2
+OFPPS_LINK_DOWN = 1 << 0
+
+
+def encode_port_status(
+    reason: int, port_no: int, state: int = 0, xid: int = 0
+) -> bytes:
+    """ofp_port_status — a switch reporting a port add/delete/modify
+    (cable events; Ryu surfaced these as Event{PortAdd,PortDelete})."""
+    body = struct.pack("!B7x", reason) + _PHY_PORT.pack(
+        port_no, b"\0" * 6, b"\0" * 16, 0, state, 0, 0, 0, 0
+    )
+    return _pack(OFPT_PORT_STATUS, body, xid)
+
+
+def decode_port_status(buf: bytes) -> tuple[int, int, int]:
+    """Returns (reason, port_no, state)."""
+    msg_type, _length, _xid = peek_header(buf)
+    if msg_type != OFPT_PORT_STATUS:
+        raise ValueError(f"not a port_status (type {msg_type})")
+    (reason,) = struct.unpack_from("!B", buf, _HEADER.size)
+    port_no, _hw, _name, _config, state, *_rest = _PHY_PORT.unpack_from(
+        buf, _HEADER.size + 8
+    )
+    return reason, port_no, state
+
+
+def encode_features_request(xid: int = 0) -> bytes:
+    """ofp_header-only OFPT_FEATURES_REQUEST — the controller's first
+    question after Hello in the OF 1.0 handshake (Ryu performed this
+    for the reference before any app saw the datapath)."""
+    return _pack(OFPT_FEATURES_REQUEST, b"", xid)
+
+
+_FEATURES_HEAD = struct.Struct("!QIB3xII")  # ofp_switch_features fixed part
+_PHY_PORT = struct.Struct("!H6s16sIIIIII")  # ofp_phy_port, 48 bytes
+
+
+def encode_features_reply(
+    dpid: int, port_nos: list[int], xid: int = 0, n_buffers: int = 256,
+    n_tables: int = 1,
+) -> bytes:
+    """ofp_switch_features + one ofp_phy_port per port. Port hw_addr is
+    derived from (dpid, port_no) and names are synthesized — the
+    controller only consumes dpid + port numbers (core Switch entity)."""
+    body = _FEATURES_HEAD.pack(dpid, n_buffers, n_tables, 0, 0)
+    for p in port_nos:
+        hw = bytes([0x02, 0, (dpid >> 16) & 0xFF, (dpid >> 8) & 0xFF,
+                    dpid & 0xFF, p & 0xFF])
+        name = f"port{p}".encode()[:15]
+        body += _PHY_PORT.pack(p, hw, name.ljust(16, b"\0"), 0, 0, 0, 0, 0, 0)
+    return _pack(OFPT_FEATURES_REPLY, body, xid)
+
+
+def decode_features_reply(buf: bytes) -> tuple[int, list[int]]:
+    """Returns (datapath_id, [port_no, ...]); OFPP_LOCAL and other
+    reserved ports (>= 0xff00) are filtered — the topology model tracks
+    only physical ports."""
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_FEATURES_REPLY:
+        raise ValueError(f"not a features_reply (type {msg_type})")
+    dpid, _bufs, _tables, _cap, _act = _FEATURES_HEAD.unpack_from(
+        buf, _HEADER.size
+    )
+    ports = []
+    off = _HEADER.size + _FEATURES_HEAD.size
+    while off + _PHY_PORT.size <= length:
+        (port_no, *_rest) = _PHY_PORT.unpack_from(buf, off)
+        if port_no < 0xFF00:
+            ports.append(port_no)
+        off += _PHY_PORT.size
+    return dpid, ports
+
+
 def encode_port_stats_request(
     port_no: int = of.OFPP_NONE, xid: int = 0
 ) -> bytes:

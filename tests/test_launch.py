@@ -40,7 +40,7 @@ class TestArgParsing:
     def test_topo_specs(self):
         for spec, n_switches in (
             ("linear:4", 4), ("ring:6", 6), ("fattree:4", 20),
-            ("dragonfly:4,4", 16), ("torus:3,3", 9),
+            ("dragonfly:4,4", 16), ("torus:3,3", 9), ("torus:2,3,4", 24),
         ):
             assert launch.parse_topo(spec).n_switches == n_switches
 
@@ -90,6 +90,59 @@ class TestLiveRun:
         asyncio.run(launch.amain(
             self._args(tmp_path, observe_links=True, wire=True)
         ))
+
+    def test_listen_implies_observe_links(self, tmp_path):
+        """LLDP discovery is the only link/host source in real-switch
+        mode, so --listen must force it on in the derived config."""
+        args = self._args(tmp_path, listen="127.0.0.1:0", demo=False)
+        assert launch.config_from_args(args).observe_links
+        assert not launch.config_from_args(
+            self._args(tmp_path, demo=False)
+        ).observe_links
+
+    def test_listen_mode_serves_real_of_bytes(self, tmp_path):
+        """--listen boots the TCP southbound inside the launcher runtime;
+        a scripted raw-byte switch completes the handshake and receives
+        the bootstrap flows while amain is live."""
+        import random
+
+        from tests.test_southbound import FakeSwitch
+
+        async def run(port):
+            task = asyncio.ensure_future(launch.amain(self._args(
+                tmp_path, listen=f"127.0.0.1:{port}", demo=False, duration=5,
+            )))
+            await asyncio.sleep(0.3)  # server up
+            try:
+                sw = FakeSwitch(dpid=5, ports=[1, 2])
+                await sw.connect(port)
+                await sw.pump(0.4)
+                assert sorted(
+                    m.priority for m in sw.flow_mods
+                ) == [0xFFFE, 0xFFFF]
+                await sw.close()
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        for attempt in range(3):  # random port may collide; retry
+            try:
+                asyncio.run(run(random.randint(20000, 40000)))
+                break
+            except (OSError, ConnectionError):
+                if attempt == 2:
+                    raise
+
+    def test_adaptive_policy_on_torus_demo(self, tmp_path):
+        """The CLI's adaptive (UGAL) policy serves demo collectives on a
+        3D torus end to end — the new topology family through the whole
+        launcher/controller stack, not just the oracle."""
+        asyncio.run(launch.amain(self._args(
+            tmp_path, topo="torus:2,2,2", policy="adaptive", backend="jax",
+        )))
 
     def test_event_log_replays_to_identical_topology(self, tmp_path):
         """The log is a complete record: replaying only its discovery

@@ -195,6 +195,24 @@ class TestRoundTrips:
         )
         assert back == entries
 
+    def test_features_reply_roundtrip_and_layout(self):
+        """ofp_switch_features: fixed 32-byte head + 48-byte phy ports;
+        reserved ports (>= 0xff00) are filtered on decode."""
+        wire = ofwire.encode_features_reply(0x00002AB5, [1, 2, 65534], xid=9)
+        msg_type, length, xid = ofwire.peek_header(wire)
+        assert msg_type == ofwire.OFPT_FEATURES_REPLY and xid == 9
+        assert length == 8 + 24 + 3 * 48  # header + fixed + 3 phy ports
+        dpid, ports = ofwire.decode_features_reply(wire)
+        assert dpid == 0x2AB5
+        assert ports == [1, 2]  # OFPP_LOCAL filtered
+        # datapath_id sits big-endian right after the header
+        assert wire[8:16] == (0x2AB5).to_bytes(8, "big")
+
+    def test_features_request_is_header_only(self):
+        wire = ofwire.encode_features_request(xid=4)
+        msg_type, length, xid = ofwire.peek_header(wire)
+        assert (msg_type, length, xid) == (ofwire.OFPT_FEATURES_REQUEST, 8, 4)
+
     def test_stream_framing(self):
         """peek_header frames a concatenated byte stream, as on a real
         OF TCP channel."""
