@@ -348,6 +348,34 @@ def test_disconnect_prunes_dead_switch_links():
     asyncio.run(run())
 
 
+def test_mpi_announcement_over_tcp_registers_rank():
+    """The full MPI lifecycle sideband over the real transport: a rank's
+    UDP:61000 LAUNCH broadcast arrives as packet-in bytes and lands in
+    the rank registry (reference path: process.py:81-119 behind Ryu)."""
+    from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+
+    async def run():
+        sb, controller = await _stack()
+        sw = FakeSwitch(dpid=1, ports=[1])
+        await sw.connect(sb.bound_port)
+        await sw.pump(0.3)
+
+        pkt = of.Packet(
+            "04:00:00:00:00:07", "ff:ff:ff:ff:ff:ff",
+            ip_proto=of.IPPROTO_UDP, udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, 7).encode(),
+        )
+        await sw.send(ofwire.encode_packet_in(pkt, in_port=1, xid=9))
+        await sw.pump(0.3)
+        assert controller.process_manager.rankdb.get_mac(7) == (
+            "04:00:00:00:00:07"
+        )
+        await sw.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
 def test_flow_removed_bytes_reach_the_router():
     async def run():
         sb, controller = await _stack()
