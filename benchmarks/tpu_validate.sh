@@ -1,0 +1,10 @@
+#!/bin/sh
+# Serial real-TPU validation batch — run after tunnel recovery.
+# One TPU process at a time (two concurrent clients can wedge the
+# tunnel; see .claude/skills/verify/SKILL.md gotchas).
+set -x
+SDNMPI_TEST_TPU=1 timeout 1200 python -m pytest tests/test_kernels_tpu.py -q || exit 1
+timeout 900 python bench.py || exit 2
+timeout 1800 python -m benchmarks.run 6 7 || exit 3
+timeout 900 python -m benchmarks.profile_stages fattree:32 128 || true
+timeout 900 python -m benchmarks.profile_stages torus:6,6,6 128 || true

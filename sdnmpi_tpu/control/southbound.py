@@ -175,6 +175,17 @@ class OFSouthbound:
             log.info("datapath %#x connected (%d ports)", new_dpid,
                      len(port_nos))
             return new_dpid
+        if msg_type == ofwire.OFPT_ERROR:
+            # before the dpid guard: a switch rejecting the handshake's
+            # own FEATURES_REQUEST errors while dpid is still unknown
+            err_type, code, data = ofwire.decode_error(msg)
+            log.warning(
+                "switch %s rejected a request: xid=%d error type=%d "
+                "code=%d (%d bytes of offending message)",
+                f"{dpid:#x}" if dpid is not None else "(pre-handshake)",
+                xid, err_type, code, len(data),
+            )
+            return dpid
         if dpid is None:
             log.debug("pre-handshake message type %d ignored", msg_type)
             return dpid

@@ -434,6 +434,22 @@ def decode_flow_removed(buf: bytes) -> dict:
     }
 
 
+def encode_error(err_type: int, code: int, data: bytes = b"",
+                 xid: int = 0) -> bytes:
+    """ofp_error_msg — switches reject bad requests with these; the
+    southbound surfaces them instead of dropping them on the floor."""
+    return _pack(OFPT_ERROR, struct.pack("!HH", err_type, code) + data, xid)
+
+
+def decode_error(buf: bytes) -> tuple[int, int, bytes]:
+    """Returns (err_type, code, data) of an ofp_error_msg."""
+    msg_type, length, _xid = peek_header(buf)
+    if msg_type != OFPT_ERROR:
+        raise ValueError(f"not an error message (type {msg_type})")
+    err_type, code = struct.unpack_from("!HH", buf, _HEADER.size)
+    return err_type, code, buf[_HEADER.size + 4:length]
+
+
 OFPT_PORT_STATUS = 12
 OFPPR_ADD = 0
 OFPPR_DELETE = 1

@@ -348,6 +348,52 @@ def test_disconnect_prunes_dead_switch_links():
     asyncio.run(run())
 
 
+def test_switch_error_is_surfaced_not_fatal(caplog):
+    """An ofp_error from the switch logs a warning and the channel
+    stays up — errors are diagnostics, not disconnects."""
+    import logging as _logging
+
+    async def run():
+        sb, controller = await _stack()
+        sw = FakeSwitch(dpid=1, ports=[1])
+        await sw.connect(sb.bound_port)
+        await sw.pump(0.3)
+        with caplog.at_level(_logging.WARNING, logger="OFSouthbound"):
+            await sw.send(ofwire.encode_error(1, 6, b"\x01\x0e\x00\x08", xid=2))
+            await sw.send(ofwire.encode_echo_request(b"still-up", xid=3))
+            await sw.pump(0.3)
+        assert sw.echo_replies == [b"still-up"]  # channel survived
+        msgs = [r.message for r in caplog.records]
+        assert any("rejected a request" in m and "xid=2" in m for m in msgs)
+        await sw.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
+def test_pre_handshake_error_is_surfaced(caplog):
+    """A switch that rejects the FEATURES_REQUEST errors before any
+    dpid is known — that must warn, not vanish at debug level."""
+    import logging as _logging
+
+    async def run():
+        sb, controller = await _stack()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", sb.bound_port
+        )
+        with caplog.at_level(_logging.WARNING, logger="OFSouthbound"):
+            writer.write(ofwire.encode_hello(xid=1))
+            writer.write(ofwire.encode_error(1, 1, b"", xid=2))  # BAD_REQUEST
+            await writer.drain()
+            await asyncio.sleep(0.3)
+        msgs = [r.message for r in caplog.records]
+        assert any("pre-handshake" in m and "rejected" in m for m in msgs)
+        writer.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
 def test_mpi_announcement_over_tcp_registers_rank():
     """The full MPI lifecycle sideband over the real transport: a rank's
     UDP:61000 LAUNCH broadcast arrives as packet-in bytes and lands in
