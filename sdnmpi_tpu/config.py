@@ -118,6 +118,11 @@ class Config:
     #: links/hosts from packet-ins instead of trusting direct entity
     #: events — pair with Fabric(discovery="packet")
     observe_links: bool = False
+    #: periodic LLDP reprobe period in real-switch mode (--listen),
+    #: seconds; a lost probe frame otherwise never heals because
+    #: discovery is event-driven (Ryu refloods on a timer too).
+    #: 0 disables.
+    lldp_reprobe_interval: float = 15.0
 
     # --- tracing / profiling (SURVEY §5: reference has none) -------------
     #: JSONL structured trace log path ("" = disabled); records oracle

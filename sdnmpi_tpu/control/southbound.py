@@ -177,13 +177,22 @@ class OFSouthbound:
             return new_dpid
         if msg_type == ofwire.OFPT_ERROR:
             # before the dpid guard: a switch rejecting the handshake's
-            # own FEATURES_REQUEST errors while dpid is still unknown
-            err_type, code, data = ofwire.decode_error(msg)
+            # own FEATURES_REQUEST errors while dpid is still unknown.
+            # Errors are diagnostics, not disconnects — even malformed
+            # ones (a truncated body must not become newly fatal).
+            who = f"{dpid:#x}" if dpid is not None else "(pre-handshake)"
+            try:
+                err_type, code, data = ofwire.decode_error(msg)
+            except (ValueError, struct.error):
+                log.warning(
+                    "switch %s sent a malformed error message (%d bytes)",
+                    who, len(msg),
+                )
+                return dpid
             log.warning(
                 "switch %s rejected a request: xid=%d error type=%d "
                 "code=%d (%d bytes of offending message)",
-                f"{dpid:#x}" if dpid is not None else "(pre-handshake)",
-                xid, err_type, code, len(data),
+                who, xid, err_type, code, len(data),
             )
             return dpid
         if dpid is None:

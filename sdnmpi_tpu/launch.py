@@ -118,6 +118,7 @@ def config_from_args(args) -> Config:
         trace_log=args.trace_log or "",
         profile_dir=args.profile_dir or "",
         observe_links=args.observe_links or bool(listen),
+        lldp_reprobe_interval=args.lldp_reprobe,
         flow_idle_timeout=args.flow_idle_timeout,
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
@@ -171,6 +172,19 @@ async def amain(args) -> None:
 
     if spec is None:
         await fabric.serve()  # accept real OF 1.0 switches
+        if (
+            controller.discovery is not None
+            and config.lldp_reprobe_interval > 0
+        ):
+            async def reprobe() -> None:
+                # heal lost probe frames: discovery is event-driven, so
+                # a dropped LLDP packet would otherwise hide a link
+                # until the next port event
+                while True:
+                    await asyncio.sleep(config.lldp_reprobe_interval)
+                    controller.discovery.probe()
+
+            tasks.append(asyncio.create_task(reprobe()))
     else:
         async def clock() -> None:
             # drive the fabric's flow-expiry clock (a real switch ages
@@ -237,6 +251,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="real-switch mode: serve OpenFlow 1.0 over TCP instead of "
              "simulating --topo (e.g. --listen 6633); switches dial in "
              "like they dialed the reference's ryu-manager",
+    )
+    parser.add_argument(
+        "--lldp-reprobe", type=float, default=15.0,
+        help="periodic LLDP reflood seconds in --listen mode (0 = off)",
     )
     parser.add_argument("--backend", choices=["jax", "py"], default="jax")
     parser.add_argument("--rpc-host", default="127.0.0.1")

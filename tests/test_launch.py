@@ -59,6 +59,7 @@ class TestLiveRun:
             profile_dir = None
             observe_links = False
             wire = False
+            lldp_reprobe = 15.0
             flow_idle_timeout = 0
             flow_hard_timeout = 0
             mesh_devices = 0
@@ -129,6 +130,45 @@ class TestLiveRun:
                     pass
 
         for attempt in range(3):  # random port may collide; retry
+            try:
+                asyncio.run(run(random.randint(20000, 40000)))
+                break
+            except (OSError, ConnectionError):
+                if attempt == 2:
+                    raise
+
+    def test_listen_mode_periodic_lldp_reprobe(self, tmp_path):
+        """Lost probe frames heal: in --listen mode the discovery app
+        refloods LLDP on a timer, so a connected switch keeps receiving
+        probe packet-outs after the connect-time flood."""
+        import random
+
+        from sdnmpi_tpu.protocol import openflow as of
+        from tests.test_southbound import FakeSwitch
+
+        async def run(port):
+            task = asyncio.ensure_future(launch.amain(self._args(
+                tmp_path, listen=f"127.0.0.1:{port}", demo=False,
+                duration=5, lldp_reprobe=0.15,
+            )))
+            await asyncio.sleep(0.3)
+            try:
+                sw = FakeSwitch(dpid=4, ports=[1, 2])
+                await sw.connect(port)
+                await sw.pump(0.8)
+                lldp = [p for p in sw.packet_outs
+                        if p.data.eth_type == of.ETH_TYPE_LLDP]
+                # connect-time flood (2 ports) + at least one reflood
+                assert len(lldp) >= 4, f"only {len(lldp)} LLDP probes"
+                await sw.close()
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+        for attempt in range(3):
             try:
                 asyncio.run(run(random.randint(20000, 40000)))
                 break

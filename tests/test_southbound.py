@@ -371,6 +371,31 @@ def test_switch_error_is_surfaced_not_fatal(caplog):
     asyncio.run(run())
 
 
+def test_malformed_error_message_not_fatal(caplog):
+    """A header-only OFPT_ERROR (no type/code body) is itself just a
+    diagnostic — it must warn and keep the channel up."""
+    import logging as _logging
+
+    async def run():
+        sb, controller = await _stack()
+        sw = FakeSwitch(dpid=1, ports=[1])
+        await sw.connect(sb.bound_port)
+        await sw.pump(0.3)
+        with caplog.at_level(_logging.WARNING, logger="OFSouthbound"):
+            await sw.send(struct.pack(  # ERROR with empty body
+                "!BBHI", ofwire.OFP_VERSION, ofwire.OFPT_ERROR, 8, 4
+            ))
+            await sw.send(ofwire.encode_echo_request(b"alive", xid=5))
+            await sw.pump(0.3)
+        assert sw.echo_replies == [b"alive"]
+        assert any("malformed error" in r.message for r in caplog.records)
+        assert sb.connected_dpids() == [1]
+        await sw.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
 def test_pre_handshake_error_is_surfaced(caplog):
     """A switch that rejects the FEATURES_REQUEST errors before any
     dpid is known — that must warn, not vanish at debug level."""
