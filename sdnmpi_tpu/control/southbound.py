@@ -241,10 +241,25 @@ class OFSouthbound:
 
     # -- southbound API used by the apps (Fabric-compatible) ---------------
 
+    #: a switch that stops reading gets disconnected once this much
+    #: unsent data accumulates, instead of buffering without bound —
+    #: the same stalled-peer policy as the RPC mirror's backlog cap
+    MAX_WRITE_BUFFER = 4 * 1024 * 1024
+
     def _send(self, dpid: int, payload: bytes) -> None:
         w = self._writers.get(dpid)
         if w is None:  # datapath died between event and send
             log.debug("send to unknown dpid %s dropped", dpid)
+            return
+        if w.transport.get_write_buffer_size() > self.MAX_WRITE_BUFFER:
+            log.warning(
+                "datapath %#x stalled (%d bytes unsent); disconnecting",
+                dpid, w.transport.get_write_buffer_size(),
+            )
+            # abort, not close: close() waits to flush a buffer the
+            # stalled peer will never read, so connection_lost — and the
+            # reader loop's datapath-down publication — would never fire
+            w.transport.abort()
             return
         w.write(payload)  # drained by the connection's event loop
 

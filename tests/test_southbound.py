@@ -348,6 +348,34 @@ def test_disconnect_prunes_dead_switch_links():
     asyncio.run(run())
 
 
+def test_stalled_switch_is_disconnected_not_buffered():
+    """A switch that stops reading must be dropped once the write
+    buffer passes the cap, not buffered without bound."""
+
+    class StallTransport:
+        aborted = False
+
+        def get_write_buffer_size(self):
+            return OFSouthbound.MAX_WRITE_BUFFER + 1
+
+        def abort(self):
+            # abort (drop + connection_lost now), NOT close (which
+            # would wait forever to flush to the unreading peer)
+            self.aborted = True
+
+    class StallWriter:
+        transport = StallTransport()
+
+        def write(self, data):  # pragma: no cover - must not be reached
+            raise AssertionError("wrote to a stalled switch")
+
+    sb = OFSouthbound(port=0)
+    w = StallWriter()
+    sb._writers[5] = w
+    sb.flow_mod(5, of.FlowMod(of.Match(), (), priority=1))
+    assert w.transport.aborted
+
+
 def test_switch_error_is_surfaced_not_fatal(caplog):
     """An ofp_error from the switch logs a warning and the channel
     stays up — errors are diagnostics, not disconnects."""
