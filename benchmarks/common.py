@@ -13,8 +13,13 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    """The one-JSON-line stdout contract shared with bench.py."""
+def emit(metric: str, value: float, unit: str, vs_baseline: float,
+         **extra) -> None:
+    """The one-JSON-line stdout contract shared with bench.py; ``extra``
+    carries run-to-run context like windows_ms (rounded here — the one
+    place the spread's precision is decided)."""
+    if "windows_ms" in extra:
+        extra["windows_ms"] = [round(float(w), 3) for w in extra["windows_ms"]]
     print(
         json.dumps(
             {
@@ -22,6 +27,7 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
                 "value": round(float(value), 3),
                 "unit": unit,
                 "vs_baseline": round(float(vs_baseline), 3),
+                **extra,
             }
         ),
         flush=True,
@@ -65,8 +71,9 @@ def alltoall_problem(spec, t, n_ranks: int):
 def measure_route(route_fn, n_stream: int = 10):
     """Compile + warm ``route_fn`` (device-buffer thunk), then measure a
     pipelined dispatch/fetch stream. Returns ``(ms_per_item,
-    first_buffer_host)`` — the shared protocol of the route-latency
-    configs."""
+    first_buffer_host, windows_ms)`` — the shared protocol of the
+    route-latency configs; windows_ms is the per-window spread that
+    belongs next to every best-of figure (tunnel jitter is bursty)."""
     first = np.asarray(route_fn())
     np.asarray(route_fn())
 
@@ -78,8 +85,8 @@ def measure_route(route_fn, n_stream: int = 10):
             pass
         return np.asarray(b)
 
-    ms, _, _ = stream_throughput(dispatch_fetch, n_stream=n_stream)
-    return ms, first
+    ms, _, windows = stream_throughput(dispatch_fetch, n_stream=n_stream)
+    return ms, first, windows
 
 
 def naive_single_path_load(adj_dev, dist_dev, usrc, udst, weight, max_len, v):

@@ -82,7 +82,7 @@ def main() -> None:
             pass
         return np.asarray(b)
 
-    t_route_ms, _, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10)
     t_route = t_route_ms / 1e3
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
@@ -97,6 +97,7 @@ def main() -> None:
     emit(
         "alltoall512_fattree16_route_ms", t_route * 1e3, "ms",
         naive_load.max() / max(load.max(), 1.0),
+        windows_ms=windows,
     )
 
 

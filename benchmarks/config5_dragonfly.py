@@ -79,7 +79,7 @@ def main() -> None:
                 pass
         return [np.asarray(o) for o in outs]
 
-    t_route_ms, _, _ = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10)
     t_route = t_route_ms / 1e3
     inter_m, n1m, n2m = run(1e9)  # hysteresis so high UGAL never detours
 
@@ -92,7 +92,8 @@ def main() -> None:
     log(f"route {t_route * 1e3:.2f} ms for {N_FLOWS:,} flows; "
         f"{frac:.0%} detoured; max congestion adaptive {load_a.max():,.0f} "
         f"vs minimal {load_m.max():,.0f} ({flatten:.2f}x flatter)")
-    emit("ugal10k_dragonfly8x32_route_ms", t_route * 1e3, "ms", flatten)
+    emit("ugal10k_dragonfly8x32_route_ms", t_route * 1e3, "ms", flatten,
+         windows_ms=windows)
 
 
 if __name__ == "__main__":

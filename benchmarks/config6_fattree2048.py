@@ -95,7 +95,7 @@ def main() -> None:
     log(f"fast path: bfs={pallas_supported(v)} sampler="
         f"{sampler_supported(v, max_len - 2, n_flows=len(usrc), t_dst=t_dst)}")
 
-    t_route_ms, buf = measure_route(lambda: route_collective(*args, **kw))
+    t_route_ms, buf, windows = measure_route(lambda: route_collective(*args, **kw))
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     adj = np.asarray(t.adj)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
@@ -109,7 +109,7 @@ def main() -> None:
         f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
     emit(
         "alltoall8192_fattree2048_route_ms", t_route_ms, "ms",
-        naive_load.max() / max(load.max(), 1.0),
+        naive_load.max() / max(load.max(), 1.0), windows_ms=windows,
     )
 
     # ceiling demo: same workload, V artificially padded to 2048 so the
@@ -119,9 +119,10 @@ def main() -> None:
     log(f"ceiling demo: V padded {spec2.n_switches} -> {v2}, "
         f"bfs={pallas_supported(v2)} sampler="
         f"{sampler_supported(v2, kw2['max_len'] - 2, n_flows=len(usrc2), t_dst=kw2['dst_nodes'].shape[0])}")
-    t2_ms, _ = measure_route(lambda: route_collective(*args2, **kw2))
+    t2_ms, _, windows2 = measure_route(lambda: route_collective(*args2, **kw2))
     log(f"ceiling demo route {t2_ms:.2f} ms at V={v2}")
-    emit("alltoall8192_v2048pad_route_ms", t2_ms, "ms", t_route_ms / t2_ms)
+    emit("alltoall8192_v2048pad_route_ms", t2_ms, "ms", t_route_ms / t2_ms,
+         windows_ms=windows2)
 
 
 if __name__ == "__main__":

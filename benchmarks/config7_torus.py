@@ -79,7 +79,7 @@ def main() -> None:
     kw = dict(levels=levels, rounds=2, max_len=max_len,
               max_degree=t.max_degree, dist=dist_d)
 
-    t_route_ms, buf = measure_route(lambda: route_collective(*args, **kw))
+    t_route_ms, buf, windows = measure_route(lambda: route_collective(*args, **kw))
 
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)
@@ -93,7 +93,7 @@ def main() -> None:
         f"{load.max():,.0f} vs single-path {naive_load.max():,.0f}")
     emit(
         "alltoall4096_torus666_route_ms", t_route_ms, "ms",
-        naive_load.max() / max(load.max(), 1.0),
+        naive_load.max() / max(load.max(), 1.0), windows_ms=windows,
     )
 
 
