@@ -411,6 +411,63 @@ def test_sim_and_tcp_southbounds_install_identical_flows():
     assert tcp_flows, "the route must have installed at least one flow"
 
 
+def test_flow_block_set_expands_and_tears_down_over_wire():
+    """The array-native collective install degrades to per-member
+    FlowMods on the wire (OF 1.0 has no block message), and the cookie
+    teardown issues matching OFPFC_DELETEs."""
+    import numpy as np
+
+    from sdnmpi_tpu.utils.mac import mac_to_int
+
+    async def run():
+        sb, controller = await _stack()
+        sw = FakeSwitch(dpid=1, ports=[1, 2])
+        await sw.connect(sb.bound_port)
+        await sw.pump(0.3)
+        sw.flow_mods.clear()
+
+        # one sub-flow (switch 1 -> final port 2) with two members
+        block = of.FlowBlockSet(
+            hop_dpid=np.array([[1]], np.int64),
+            hop_port=np.array([[2]], np.int32),
+            hop_len=np.array([1], np.int32),
+            bounds=np.array([0, 2], np.int64),
+            src=np.array([mac_to_int("04:00:00:00:00:01"),
+                          mac_to_int("04:00:00:00:00:02")], np.int64),
+            dst=np.array([mac_to_int("06:00:00:00:00:09")] * 2, np.int64),
+            final_port=np.array([2, 2], np.int32),
+            rewrite=np.array([mac_to_int("04:00:00:00:00:09")] * 2, np.int64),
+            cookie=77,
+        )
+        sb.flow_block_set(block)
+        await sw.pump(0.3)
+        assert len(sw.flow_mods) == 2
+        for m in sw.flow_mods:
+            assert m.command == of.OFPFC_ADD and m.cookie == 77
+            # final hop: rewrite to the true MAC, then output
+            assert m.actions == (
+                of.ActionSetDlDst("04:00:00:00:00:09"), of.ActionOutput(2),
+            )
+        assert {m.match.dl_src for m in sw.flow_mods} == {
+            "04:00:00:00:00:01", "04:00:00:00:00:02",
+        }
+
+        sw.flow_mods.clear()
+        sb.flow_blocks_delete(77)
+        await sw.pump(0.3)
+        assert len(sw.flow_mods) == 2
+        assert all(m.command == of.OFPFC_DELETE for m in sw.flow_mods)
+        # teardown is idempotent: the cookie's record is consumed
+        sw.flow_mods.clear()
+        sb.flow_blocks_delete(77)
+        await sw.pump(0.2)
+        assert sw.flow_mods == []
+        await sw.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
 def test_stalled_switch_is_disconnected_not_buffered():
     """A switch that stops reading must be dropped once the write
     buffer passes the cap, not buffered without bound."""
