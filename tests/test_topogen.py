@@ -154,6 +154,33 @@ class TestTorusND:
         with pytest.raises(ValueError):
             torus((4, 1))
 
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_dims_invariants(self, seed):
+        """Any dims tuple: degree = sum of per-dim ring contributions
+        (2 per dim, 1 for size-2 dims), expected cable count, unique
+        ports, full connectivity, exact diameter."""
+        rng = np.random.default_rng(seed)
+        ndims = int(rng.integers(1, 4))
+        dims = tuple(int(rng.integers(2, 5)) for _ in range(ndims))
+        spec = torus(dims)
+        n = int(np.prod(dims))
+        assert spec.n_switches == n
+        exp_degree = sum(1 if s == 2 else 2 for s in dims)
+        deg = degree_counts(spec)
+        assert all(d == exp_degree for d in deg.values()), (dims, deg)
+        assert len(spec.links) == n * exp_degree // 2
+        no_duplicate_ports(spec)
+
+        from sdnmpi_tpu.oracle.apsp import apsp_distances
+        from sdnmpi_tpu.oracle.engine import tensorize
+
+        db = spec.to_topology_db(backend="jax")
+        t = tensorize(db, pad_multiple=8)
+        dist = np.asarray(apsp_distances(t.adj))
+        real = dist[: t.n_real, : t.n_real]
+        assert np.isfinite(real).all(), f"torus {dims} must be connected"
+        assert real.max() == sum(s // 2 for s in dims)
+
     def test_diameter_and_routability(self):
         spec = torus((4, 4, 4))
         db = spec.to_topology_db(backend="jax")
