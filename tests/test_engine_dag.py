@@ -44,6 +44,37 @@ def _validate_fdbs(db, pairs, fdbs):
         assert fdb[-1][1] == db.hosts[b].port.port_no
 
 
+def test_engine_engages_dst_restriction_at_scale():
+    """At fat-tree k=16 scale (V > the 128 dst-set pad floor) the
+    production oracle must route through route_collective with
+    dst_nodes set — the perf-critical restriction is live in the
+    controller path, not just the unit layer — and the result must
+    stay valid."""
+    from unittest import mock
+
+    from sdnmpi_tpu.oracle import dag
+
+    calls = []
+    orig = dag.route_collective
+
+    def spy(*a, **k):
+        calls.append(k.get("dst_nodes") is not None)
+        return orig(*a, **k)
+
+    spec = fattree(16)
+    db = spec.to_topology_db(backend="jax")
+    oracle = RouteOracle()
+    macs = sorted(db.hosts)[:32]
+    pairs = [(a, b) for a in macs for b in macs if a != b]
+    with mock.patch.object(dag, "route_collective", spy):
+        fdbs, maxc = oracle.routes_batch_balanced(
+            db, pairs, dag_threshold=100
+        )
+    assert calls == [True], f"restricted DAG call expected, got {calls}"
+    assert maxc > 0
+    _validate_fdbs(db, pairs, fdbs)
+
+
 class TestDagDispatch:
     def test_dag_path_valid_shortest_and_congestion_matches_fdbs(self):
         db = fattree(8).to_topology_db(backend="jax")
