@@ -64,7 +64,9 @@ def test_engine_engages_dst_restriction_at_scale():
     spec = fattree(16)
     db = spec.to_topology_db(backend="jax")
     oracle = RouteOracle()
-    macs = sorted(db.hosts)[:32]
+    # 64 hosts span 8 edge switches -> 56 switch pairs x ECMP ways
+    # clears the DAG threshold (32 hosts = 4 switches would not)
+    macs = sorted(db.hosts)[:64]
     pairs = [(a, b) for a in macs for b in macs if a != b]
     with mock.patch.object(dag, "route_collective", spy):
         fdbs, maxc = oracle.routes_batch_balanced(
