@@ -98,6 +98,38 @@ def test_sampler_two_word_packing_matches_xla():
     np.testing.assert_array_equal(sp, np.asarray(sd))
 
 
+def test_sampler_dstset_two_word_combined_matches_xla():
+    """Both kernel variants in one program on real Mosaic: compact d2e
+    destination set AND >4-hop two-word packing (torus-scale diameters
+    with restricted destinations)."""
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import (
+        congestion_weights,
+        make_dst_nodes,
+        sample_paths_dense,
+    )
+
+    hops = 6
+    v = 1024
+    f = 8192
+    rng = np.random.default_rng(8)
+    members = rng.choice(v, 300, replace=False).astype(np.int32)
+    dn = jnp.asarray(make_dst_nodes(members))
+    assert sampler_supported(v, hops, n_flows=f, t_dst=int(dn.shape[0]))
+    adj = jnp.asarray(_random_graph(v, seed=9))
+    cost = jnp.asarray(rng.uniform(0, 4, (v, v)).astype(np.float32)) * adj
+    weights = congestion_weights(adj, cost)
+    dist = apsp_distances(adj)
+    src = jnp.asarray(rng.integers(0, v, f).astype(np.int32))
+    dst = jnp.asarray(rng.choice(members, f).astype(np.int32))
+    sp = np.asarray(sample_slots_pallas(
+        weights, dist, src, dst, hops, salt=41, dst_nodes=dn
+    ))
+    _, sd = sample_paths_dense(weights, dist, src, dst, hops, salt=41)
+    np.testing.assert_array_equal(sp, np.asarray(sd))
+
+
 @pytest.mark.parametrize("v", [1024, 1280])
 def test_sampler_dstset_kernel_matches_xla(v):
     """Destination-set kernel layout on real Mosaic: compact [T, V] d2e
