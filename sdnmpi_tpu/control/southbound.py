@@ -132,6 +132,10 @@ class OFSouthbound:
                             f"message type {msg_type} at unnegotiated "
                             f"version 0x{version:02x}"
                         )
+                    if length < 8:
+                        # OF header is 8 bytes; a shorter declared length
+                        # would consume nothing and spin this loop forever
+                        raise ValueError(f"bad header length {length}")
                     if len(buf) < length:
                         break
                     msg, buf = buf[:length], buf[length:]
@@ -165,6 +169,17 @@ class OFSouthbound:
             return dpid
         if msg_type == ofwire.OFPT_FEATURES_REPLY:
             new_dpid, port_nos = ofwire.decode_features_reply(msg)
+            stale = self._writers.get(new_dpid)
+            if stale is not None and stale is not writer:
+                # switch redialed before its old connection timed out:
+                # abort the stale transport so its reader loop exits and
+                # stops dispatching into this dpid's shared state (its
+                # cleanup is a no-op — _writers already points here)
+                log.warning(
+                    "datapath %#x reconnected; aborting stale session",
+                    new_dpid,
+                )
+                stale.transport.abort()
             self._writers[new_dpid] = writer
             self._ports[new_dpid] = set(port_nos)
             if self.bus is not None:

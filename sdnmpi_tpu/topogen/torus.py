@@ -21,8 +21,8 @@ from sdnmpi_tpu.topogen.spec import PortAllocator, TopoSpec, host_mac
 
 
 def torus(dims: tuple[int, ...], hosts_per_switch: int = 1) -> TopoSpec:
-    if not dims or any(s < 2 for s in dims):
-        raise ValueError("torus needs at least one dimension of size >= 2")
+    if not dims or any(s < 1 for s in dims):
+        raise ValueError("torus dimensions must be positive")
 
     strides = []
     acc = 1
@@ -50,6 +50,10 @@ def torus(dims: tuple[int, ...], hosts_per_switch: int = 1) -> TopoSpec:
     for c in coords:
         a = dpid(c)
         for axis, size in enumerate(dims):
+            if size == 1:
+                # degenerate axis: the only neighbor is the switch itself
+                # (torus2d(1, n)'s historical contract — no links emitted)
+                continue
             nb = list(c)
             nb[axis] = (c[axis] + 1) % size
             b = dpid(tuple(nb))

@@ -254,6 +254,64 @@ def test_truncated_message_drops_switch_not_task():
     asyncio.run(run())
 
 
+def test_zero_length_header_drops_connection_not_loop():
+    """A frame declaring length<8 consumes no bytes — without the length
+    floor the framing loop would spin forever on it, wedging the whole
+    single-threaded controller. It must instead hit the protocol-error
+    path and drop the connection."""
+
+    async def run():
+        sb, controller = await _stack()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", sb.bound_port
+        )
+        # version 1, ECHO_REQUEST, length=0 — the 8-byte wedge packet
+        writer.write(struct.pack(
+            "!BBHI", ofwire.OFP_VERSION, ofwire.OFPT_ECHO_REQUEST, 0, 1
+        ))
+        await writer.drain()
+        # server must close on us promptly (a wedge would hang here)
+        data = await asyncio.wait_for(reader.read(65536), 2)
+        while data:
+            data = await asyncio.wait_for(reader.read(65536), 2)
+        assert sb.connected_dpids() == []
+        writer.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
+def test_duplicate_dpid_reconnect_aborts_stale_session():
+    """A switch redialing before its old TCP connection dies must evict
+    the stale session: the old reader loop exits instead of dispatching
+    into the new session's shared port/stats state."""
+
+    async def run():
+        sb, controller = await _stack()
+        old = FakeSwitch(dpid=7, ports=[1, 2])
+        await old.connect(sb.bound_port)
+        await old.pump(0.3)
+        assert sb.connected_dpids() == [7]
+
+        new = FakeSwitch(dpid=7, ports=[1, 2, 3])
+        await new.connect(sb.bound_port)
+        await new.pump(0.3)
+        # still exactly one registration, owned by the new connection
+        assert sb.connected_dpids() == [7]
+        assert sb._ports[7] == {1, 2, 3}
+        # the stale socket was aborted server-side: its reader sees EOF
+        data = await asyncio.wait_for(old.reader.read(65536), 2)
+        while data:
+            data = await asyncio.wait_for(old.reader.read(65536), 2)
+        # and the abort did NOT tear down the new session's state
+        assert sb.connected_dpids() == [7]
+        assert sb._ports[7] == {1, 2, 3}
+        await new.close()
+        await sb.close()
+
+    asyncio.run(run())
+
+
 def _mklink(a, pa, b, pb):
     from sdnmpi_tpu.core.topology_db import Link, Port
 

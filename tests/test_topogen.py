@@ -152,7 +152,22 @@ class TestTorusND:
         with pytest.raises(ValueError):
             torus(())
         with pytest.raises(ValueError):
-            torus((4, 1))
+            torus((4, 0))
+
+    def test_size1_axis_emits_no_links(self):
+        """torus2d(1, n)'s historical contract: a size-1 axis has no
+        neighbors (the +1 wraparound is the switch itself), so it
+        contributes zero links instead of raising."""
+        spec = torus2d(1, 4)  # == torus((4, 1))
+        assert spec.n_switches == 4
+        # only the size-4 axis contributes: a 4-ring = 4 cables
+        assert len(spec.links) == 4
+        deg = degree_counts(spec)
+        assert all(d == 2 for d in deg.values())
+        no_duplicate_ports(spec)
+        # fully degenerate: one switch, no links at all
+        lone = torus((1, 1))
+        assert lone.n_switches == 1 and lone.links == []
 
     @pytest.mark.parametrize("seed", range(6))
     def test_random_dims_invariants(self, seed):
