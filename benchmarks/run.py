@@ -5,14 +5,24 @@ boundaries). Config 4 is the repo-root ``bench.py`` flagship. Results
 land in ``BENCH_suite.json`` and on stdout (one line per config; a
 config that emits several JSON lines — e.g. config 6's primary +
 ceiling-demo pair — contributes them all, suffixed 6, 6b, ...).
+
+Wedge discipline (round 4 lost every on-chip number to a wedged axon
+tunnel): the suite file is rewritten after EVERY config, so a later
+hang never erases earlier captures; a cheap subprocess probe runs
+between configs, and if the backend is wedged the remaining configs
+fail fast as explicit error rows instead of each burning the full
+per-config timeout. Partial runs (``python -m benchmarks.run 4 6``)
+merge into the existing suite by config id instead of clobbering it.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import subprocess
 import sys
+import time
 
 CONFIGS = [
     ("1", [sys.executable, "-m", "benchmarks.config1_bcast"]),
@@ -24,25 +34,79 @@ CONFIGS = [
     ("7", [sys.executable, "-m", "benchmarks.config7_torus"]),
 ]
 
+#: per-config wall clock cap (module-level so tests can shrink it)
+CONFIG_TIMEOUT_S = 1800
+#: between-config probe budget; a healthy tunnel answers in seconds
+PROBE_TIMEOUT_S = 60
+#: one short grace retry before declaring the backend wedged
+PROBE_RETRY_DELAY_S = 30
 
-def main() -> None:
-    root = pathlib.Path(__file__).resolve().parent.parent
-    only = set(sys.argv[1:])  # e.g. `python -m benchmarks.run 4 6`
-    known = {name for name, _ in CONFIGS}
-    if unknown := only - known:
-        sys.exit(f"unknown config(s) {sorted(unknown)}; choose from {sorted(known)}")
-    results = []
-    for name, cmd in CONFIGS:
-        if only and name not in only:
+
+def _config_base(config_id: str) -> str:
+    """'6b' -> '6' (multi-line configs suffix their extra rows)."""
+    return config_id.rstrip("abcdefghijklmnopqrstuvwxyz")
+
+
+def probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> tuple[bool, str]:
+    """Killable-subprocess accelerator touch (see common.py rationale)."""
+    from benchmarks.common import _probe_backend_subprocess
+
+    return _probe_backend_subprocess(timeout_s)
+
+
+def run_suite(
+    configs,
+    root: pathlib.Path,
+    only: set[str] | None = None,
+    timeout_s: float | None = None,
+    probe=probe_backend,
+    suite_name: str = "BENCH_suite.json",
+) -> list[dict]:
+    """Run ``configs`` (list of (name, cmd)); flush the suite file after
+    each one; fail the remainder fast if the backend probe says the
+    tunnel is wedged. Returns this run's rows (the suite file on disk
+    additionally keeps prior rows of configs not re-run here)."""
+    only = only or set()
+    timeout_s = CONFIG_TIMEOUT_S if timeout_s is None else timeout_s
+    suite_path = root / suite_name
+    ran_bases = only or {name for name, _ in configs}
+    try:
+        prior = [
+            r for r in json.loads(suite_path.read_text())
+            if _config_base(r.get("config", "")) not in ran_bases
+        ]
+    except (FileNotFoundError, json.JSONDecodeError):
+        prior = []
+    results: list[dict] = []
+
+    def flush() -> None:
+        merged = sorted(prior + results, key=lambda r: r.get("config", ""))
+        suite_path.write_text(json.dumps(merged, indent=2) + "\n")
+
+    def emit(rec: dict) -> None:
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        flush()
+
+    to_run = [(n, c) for n, c in configs if not only or n in only]
+    backend_dead = None
+    for pos, (name, cmd) in enumerate(to_run):
+        last = pos == len(to_run) - 1
+        if backend_dead is not None:
+            # fail fast: an explicit row beats a full timeout per config
+            emit({"config": name, "error": f"skipped: {backend_dead}"})
             continue
-        print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr, flush=True)
+        print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr,
+              flush=True)
         try:
             proc = subprocess.run(
-                cmd, cwd=root, capture_output=True, text=True, timeout=1800
+                cmd, cwd=root, capture_output=True, text=True,
+                timeout=timeout_s,
             )
         except subprocess.TimeoutExpired:
-            results.append({"config": name, "error": "timeout"})
-            print(json.dumps(results[-1]), flush=True)
+            emit({"config": name, "error": "timeout"})
+            if not last:  # the verdict only matters for remaining configs
+                backend_dead = _check_backend(probe)
             continue
         sys.stderr.write(proc.stderr)
         lines = [
@@ -50,10 +114,9 @@ def main() -> None:
             if ln.lstrip().startswith("{")
         ]
         if proc.returncode != 0 or not lines:
-            results.append(
-                {"config": name, "error": proc.returncode or "no output"}
-            )
-            print(json.dumps(results[-1]), flush=True)
+            emit({"config": name, "error": proc.returncode or "no output"})
+            if not last:
+                backend_dead = _check_backend(probe)
             continue
         for i, ln in enumerate(lines):
             suffix = "" if i == 0 else chr(ord("b") + i - 1)
@@ -61,12 +124,37 @@ def main() -> None:
                 rec = {"config": f"{name}{suffix}", **json.loads(ln)}
             except json.JSONDecodeError as e:
                 rec = {"config": f"{name}{suffix}", "error": f"bad JSON: {e}"}
-            results.append(rec)
-            print(json.dumps(rec), flush=True)
-    if not only:  # partial runs must not clobber the full-suite record
-        (root / "BENCH_suite.json").write_text(
-            json.dumps(results, indent=2) + "\n"
-        )
+            emit(rec)
+    flush()
+    return results
+
+
+def _check_backend(probe) -> str | None:
+    """After a config failure, decide whether to keep going: one probe,
+    one short-grace retry, then declare the tunnel wedged (recovery is
+    passive and can take hours — burning per-config timeouts on it
+    would cost the whole suite's wall clock)."""
+    if os.environ.get("SDNMPI_BENCH_NO_PROBE"):
+        return None
+    ok, detail = probe()
+    if ok:
+        return None
+    print(f"backend probe failed ({detail}); retrying in "
+          f"{PROBE_RETRY_DELAY_S}s", file=sys.stderr, flush=True)
+    time.sleep(PROBE_RETRY_DELAY_S)
+    ok, detail = probe()
+    if ok:
+        return None
+    return f"backend wedged ({detail})"
+
+
+def main() -> None:
+    root = pathlib.Path(__file__).resolve().parent.parent
+    only = set(sys.argv[1:])  # e.g. `python -m benchmarks.run 4 6`
+    known = {name for name, _ in CONFIGS}
+    if unknown := only - known:
+        sys.exit(f"unknown config(s) {sorted(unknown)}; choose from {sorted(known)}")
+    results = run_suite(CONFIGS, root, only)
     failed = [r for r in results if "error" in r]
     sys.exit(1 if failed else 0)
 

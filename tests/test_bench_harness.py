@@ -1,0 +1,104 @@
+"""Wedge-safety of the benchmark suite harness (benchmarks/run.py).
+
+Round 4 lost every on-chip number to a single tunnel wedge: the suite
+only wrote its JSON at the end, and each wedged config burned the full
+per-config timeout. These tests simulate a hang with real subprocesses
+and prove the hardened harness (a) keeps earlier captures, (b) fails
+the remainder fast via the between-config probe, and (c) merges
+partial re-runs instead of clobbering the suite file.
+"""
+
+import json
+import sys
+
+import pytest
+
+from benchmarks import run as bench_run
+
+OK_CMD = [sys.executable, "-c",
+          'print(\'{"metric": "m", "value": 1.0, "unit": "ms", '
+          '"vs_baseline": 2.0}\')']
+HANG_CMD = [sys.executable, "-c", "import time; time.sleep(60)"]
+FAIL_CMD = [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+
+@pytest.fixture(autouse=True)
+def fast_probe_retry(monkeypatch):
+    monkeypatch.setattr(bench_run, "PROBE_RETRY_DELAY_S", 0)
+    # keep the environment's real probe out of these tests
+    monkeypatch.delenv("SDNMPI_BENCH_NO_PROBE", raising=False)
+
+
+def read_suite(root):
+    return json.loads((root / "BENCH_suite.json").read_text())
+
+
+def test_hang_mid_suite_keeps_captures_and_fails_fast(tmp_path):
+    configs = [("1", OK_CMD), ("2", HANG_CMD), ("3", OK_CMD), ("4", OK_CMD)]
+    probes = []
+
+    def wedged_probe(timeout_s=0):
+        probes.append(1)
+        return False, "simulated wedge"
+
+    # python startup alone is ~2s in this environment (sitecustomize);
+    # 8s cleanly separates the healthy configs from the 60s hang
+    rows = bench_run.run_suite(
+        configs, tmp_path, timeout_s=8, probe=wedged_probe
+    )
+    by_config = {r["config"]: r for r in rows}
+    # the capture that landed before the hang survives
+    assert by_config["1"]["value"] == 1.0
+    # the hung config is an explicit timeout row
+    assert by_config["2"]["error"] == "timeout"
+    # the remainder failed fast (skip rows), not one timeout each
+    assert "backend wedged" in by_config["3"]["error"]
+    assert "backend wedged" in by_config["4"]["error"]
+    # probe ran twice (initial + one grace retry), then never again
+    assert len(probes) == 2
+    # and the suite file on disk has all four rows
+    assert {r["config"] for r in read_suite(tmp_path)} == {"1", "2", "3", "4"}
+
+
+def test_config_failure_with_healthy_backend_continues(tmp_path):
+    configs = [("1", FAIL_CMD), ("2", OK_CMD)]
+    rows = bench_run.run_suite(
+        configs, tmp_path, timeout_s=10, probe=lambda timeout_s=0: (True, "ok")
+    )
+    by_config = {r["config"]: r for r in rows}
+    assert by_config["1"]["error"] == 3
+    assert by_config["2"]["value"] == 1.0  # suite went on after the probe
+
+
+def test_suite_file_written_as_each_config_lands(tmp_path):
+    """The hang must not erase what already landed: by the time the
+    hung config is running, the earlier capture is already on disk."""
+    check = [sys.executable, "-c",
+             "import json, sys, pathlib\n"
+             "rows = json.loads(pathlib.Path('BENCH_suite.json').read_text())\n"
+             "assert rows and rows[0]['config'] == '1', rows\n"
+             'print(\'{"metric": "m2", "value": 2.0, "unit": "ms", '
+             '"vs_baseline": 1.0}\')']
+    rows = bench_run.run_suite(
+        [("1", OK_CMD), ("2", check)], tmp_path, timeout_s=10,
+        probe=lambda timeout_s=0: (True, "ok"),
+    )
+    assert [r["config"] for r in rows] == ["1", "2"]
+    assert rows[1]["value"] == 2.0  # the in-flight read saw config 1
+
+
+def test_partial_rerun_merges_not_clobbers(tmp_path):
+    (tmp_path / "BENCH_suite.json").write_text(json.dumps([
+        {"config": "1", "metric": "old1", "value": 9.0},
+        {"config": "6", "metric": "old6", "value": 9.0},
+        {"config": "6b", "metric": "old6b", "value": 9.0},
+    ]))
+    configs = [("1", OK_CMD), ("6", OK_CMD)]
+    bench_run.run_suite(
+        configs, tmp_path, only={"6"}, timeout_s=10,
+        probe=lambda timeout_s=0: (True, "ok"),
+    )
+    suite = {r["config"]: r for r in read_suite(tmp_path)}
+    assert suite["1"]["metric"] == "old1"  # untouched config kept
+    assert suite["6"]["metric"] == "m"  # re-run config replaced
+    assert "6b" not in suite  # stale suffix rows of the re-run config go too
