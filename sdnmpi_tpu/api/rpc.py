@@ -16,7 +16,10 @@ method names and positional params:
 plus ``remove_fdb(dpid, src, dst)`` for the flow teardowns the reference
 never performs. Calls are JSON-RPC 2.0 *notifications* (no ids — the
 reference's tinyrpc stack sent ids but ignored the replies,
-rpc_interface.py:74-85).
+rpc_interface.py:74-85). Snapshot and entity payloads are translated to
+the reference visualizer's exact schemas by ``api/wire.py`` (Ryu 3.26
+entity dicts; list-form ``init_fdb``) — internal ``to_dict`` forms never
+reach the wire.
 
 Transport is split from logic for testability: the app broadcasts to any
 object with a ``send_json(dict)`` method; ``serve()`` runs the real
@@ -31,6 +34,7 @@ import json
 import logging
 from typing import Protocol
 
+from sdnmpi_tpu.api import wire
 from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
 from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
@@ -54,11 +58,13 @@ class RPCInterface:
         bus.subscribe(ev.EventProcessDelete, lambda e: self._broadcast("delete_process", e.rank))
         bus.subscribe(ev.EventFDBUpdate, lambda e: self._broadcast("update_fdb", e.dpid, e.src, e.dst, e.port))
         bus.subscribe(ev.EventFDBRemove, lambda e: self._broadcast("remove_fdb", e.dpid, e.src, e.dst))
-        bus.subscribe(ev.EventSwitchEnter, lambda e: self._broadcast("add_switch", _to_dict(e.switch)))
-        bus.subscribe(ev.EventSwitchLeave, lambda e: self._broadcast("delete_switch", _to_dict(e.switch)))
-        bus.subscribe(ev.EventLinkAdd, lambda e: self._broadcast("add_link", _to_dict(e.link)))
-        bus.subscribe(ev.EventLinkDelete, lambda e: self._broadcast("delete_link", _to_dict(e.link)))
-        bus.subscribe(ev.EventHostAdd, lambda e: self._broadcast("add_host", _to_dict(e.host)))
+        # entity payloads go through the Ryu-3.26-exact wire ABI
+        # (api/wire.py) so a reference visualizer parses them unchanged
+        bus.subscribe(ev.EventSwitchEnter, lambda e: self._broadcast("add_switch", wire.switch(e.switch)))
+        bus.subscribe(ev.EventSwitchLeave, lambda e: self._broadcast("delete_switch", wire.switch(e.switch)))
+        bus.subscribe(ev.EventLinkAdd, lambda e: self._broadcast("add_link", wire.link(e.link)))
+        bus.subscribe(ev.EventLinkDelete, lambda e: self._broadcast("delete_link", wire.link(e.link)))
+        bus.subscribe(ev.EventHostAdd, lambda e: self._broadcast("add_host", wire.host(e.host)))
         # block-installed collectives mirror as summaries, never per-pair
         # rows (an alltoall would be 16.7M update_fdb calls); extension
         # methods beyond the reference protocol
@@ -80,11 +86,11 @@ class RPCInterface:
         """Push full state snapshots to a newly-connected client
         (reference: rpc_interface.py:34-40)."""
         fdb = self.bus.request(ev.CurrentFDBRequest()).fdb
-        self._call(client, "init_fdb", fdb.to_dict())
+        self._call(client, "init_fdb", wire.fdb(fdb))
         rankdb = self.bus.request(ev.CurrentProcessAllocationRequest()).processes
-        self._call(client, "init_rankdb", rankdb.to_dict())
+        self._call(client, "init_rankdb", wire.rankdb(rankdb))
         topology = self.bus.request(ev.CurrentTopologyRequest()).topology
-        self._call(client, "init_topologydb", topology.to_dict())
+        self._call(client, "init_topologydb", wire.topology(topology))
         collectives = self.bus.request(ev.CurrentCollectivesRequest()).collectives
         self._call(client, "init_collectives", collectives.to_dict())
 
@@ -190,9 +196,3 @@ class _WebSocketClient:
         except Exception:
             self.closed = True
             raise
-
-
-def _to_dict(entity) -> dict:
-    from sdnmpi_tpu.core.topology_db import _entity_dict
-
-    return _entity_dict(entity)

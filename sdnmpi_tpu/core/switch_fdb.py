@@ -56,8 +56,13 @@ class SwitchFDB:
                 yield dpid, src, dst, port
 
     def to_dict(self) -> dict:
-        """JSON-serializable snapshot, same layout as the reference's
-        (``{dpid: {"src dst": port}}``, sdnmpi/util/switch_fdb.py:17-32)."""
+        """JSON-serializable snapshot in this framework's INTERNAL
+        layout (``{dpid: {"src dst": port}}``) — used by
+        checkpoint/resume (api/snapshot.py). NOT the reference's
+        visualizer layout: the reference sends a list of
+        ``{"dpid", "fdb": [{"src","dst","out_port"}]}``
+        (sdnmpi/util/switch_fdb.py:17-32), which the RPC boundary
+        produces via :func:`sdnmpi_tpu.api.wire.fdb`."""
         return {
             str(dpid): {f"{src} {dst}": port for (src, dst), port in table.items()}
             for dpid, table in self.fdb.items()

@@ -103,6 +103,63 @@ class TestRPCMirror:
         assert alive in rpc.clients
         assert "add_process" in alive.methods()
 
+    def test_init_fdb_is_reference_list_layout(self):
+        """Golden vector: the exact ``init_fdb`` JSON a reference
+        visualizer receives (sdnmpi/util/switch_fdb.py:17-32 pushed at
+        rpc_interface.py:36) — a LIST of per-switch records, not the
+        internal ``{dpid: {"src dst": port}}`` checkpoint form."""
+        fabric, controller, rpc = make_stack()
+        fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[2]))
+        client = FakeClient()
+        rpc.attach_client(client)
+        payload = client.messages[0]["params"][0]
+        assert payload == [
+            {"dpid": 1, "fdb": [
+                {"src": MAC[1], "dst": MAC[2], "out_port": 2},
+            ]},
+            {"dpid": 2, "fdb": [
+                {"src": MAC[1], "dst": MAC[2], "out_port": 1},
+            ]},
+        ]
+
+    def test_init_rankdb_is_raw_rank_to_mac(self):
+        """Golden vector: ``init_rankdb`` is the bare rank->mac mapping
+        (sdnmpi/util/rank_allocation_db.py:16-17); JSON stringifies the
+        int keys at the transport, exactly as the reference's stack did."""
+        fabric, controller, rpc = make_stack()
+        announce(fabric, MAC[1], AnnouncementType.LAUNCH, 0)
+        announce(fabric, MAC[2], AnnouncementType.LAUNCH, 1)
+        client = FakeClient()
+        rpc.attach_client(client)
+        payload = client.messages[1]["params"][0]
+        assert payload == {0: MAC[1], 1: MAC[2]}
+        assert json.loads(json.dumps(payload)) == {"0": MAC[1], "1": MAC[2]}
+
+    def test_init_topologydb_is_ryu_entity_layout(self):
+        """Golden vector: topology entities serialize in Ryu 3.26's
+        ``to_dict`` schema (hex-string dpid/port_no, hw_addr + name per
+        port, ipv4/ipv6 lists per host) — what the reference broadcast
+        via ``ev.switch.to_dict()`` (rpc_interface.py:54-72)."""
+        fabric, controller, rpc = make_stack()
+        client = FakeClient()
+        rpc.attach_client(client)
+        topo = client.messages[2]["params"][0]
+        sw1 = next(s for s in topo["switches"] if s["dpid"] == "%016x" % 1)
+        port_nos = sorted(p["port_no"] for p in sw1["ports"])
+        assert port_nos == ["00000001", "00000002", "00000003"]
+        assert all(
+            set(p) == {"dpid", "port_no", "hw_addr", "name"}
+            for p in sw1["ports"]
+        )
+        names = {p["name"] for p in sw1["ports"]}
+        assert names == {"s1-eth1", "s1-eth2", "s1-eth3"}
+        h1 = next(h for h in topo["hosts"] if h["mac"] == MAC[1])
+        assert set(h1) == {"mac", "ipv4", "ipv6", "port"}
+        assert h1["port"]["dpid"] == "%016x" % 1
+        lk = topo["links"][0]
+        assert set(lk) == {"src", "dst"}
+        assert set(lk["src"]) == {"dpid", "port_no", "hw_addr", "name"}
+
     def test_messages_are_json_serializable(self):
         fabric, controller, rpc = make_stack()
         client = FakeClient()
