@@ -55,6 +55,11 @@ class Config:
     max_diameter: int = 0
     #: maximum hops materialized when reconstructing a path into an fdb
     max_path_len: int = 32
+    #: cap on FindAllRoutes equal-cost path enumeration — the path count
+    #: is exponential in rich DAGs (a k-ary fat-tree pair alone has
+    #: (k/2)^2), so the walk stops here and FindAllRoutesReply.truncated
+    #: reports that the list is partial
+    max_enumerated_paths: int = 1024
     #: weight of link utilization when scoring congestion-aware routes
     congestion_alpha: float = 1.0
     #: nominal link capacity used to normalize the Monitor's bps samples

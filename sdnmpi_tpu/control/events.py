@@ -148,6 +148,9 @@ class FindAllRoutesRequest(Request):
 @dataclasses.dataclass
 class FindAllRoutesReply(Reply):
     fdbs: list
+    #: True when enumeration stopped at Config.max_enumerated_paths —
+    #: ``fdbs`` is a prefix of the (possibly exponential) full path set
+    truncated: bool = False
 
 
 @dataclasses.dataclass

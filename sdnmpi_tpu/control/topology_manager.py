@@ -121,9 +121,11 @@ class TopologyManager:
         return ev.FindRouteReply(self.topologydb.find_route(req.src_mac, req.dst_mac))
 
     def _find_all_routes(self, req: ev.FindAllRoutesRequest) -> ev.FindAllRoutesReply:
-        return ev.FindAllRoutesReply(
-            self.topologydb.find_route(req.src_mac, req.dst_mac, multiple=True)
+        fdbs, truncated = self.topologydb.find_all_routes(
+            req.src_mac, req.dst_mac,
+            max_paths=self.config.max_enumerated_paths,
         )
+        return ev.FindAllRoutesReply(fdbs, truncated)
 
     def _find_routes_batch(
         self, req: ev.FindRoutesBatchRequest

@@ -212,22 +212,37 @@ class TopologyDB:
         ``multiple`` is set. Same contract as the reference
         (topology_db.py:140-188) except single-path results are shortest.
         """
+        if multiple:
+            return self.find_all_routes(src_mac, dst_mac)[0]
         src = self._resolve_endpoint(src_mac)
         dst = self._resolve_endpoint(dst_mac)
         if src is None or dst is None:
             return []
         src_dpid, _ = src
         dst_dpid, is_local_dst = dst
-
-        if multiple:
-            routes = self._shortest_routes(src_dpid, dst_dpid)
-            return [
-                self._route_to_fdb(r, dst_mac, dst_dpid, is_local_dst) for r in routes
-            ]
         route = self._shortest_route(src_dpid, dst_dpid)
         if not route:
             return []
         return self._route_to_fdb(route, dst_mac, dst_dpid, is_local_dst)
+
+    def find_all_routes(
+        self, src_mac: str, dst_mac: str, max_paths: Optional[int] = None
+    ) -> tuple[list, bool]:
+        """All equal-cost shortest routes as fdbs, with a truncation
+        flag. ``max_paths`` bounds the inherently-exponential
+        enumeration (see ``_py_all_shortest_routes``) — the fix-of-the-
+        fix of the reference's dead FindAllRoutes API
+        (sdnmpi/topology.py:37-48). Returns ``(fdbs, truncated)``."""
+        src = self._resolve_endpoint(src_mac)
+        dst = self._resolve_endpoint(dst_mac)
+        if src is None or dst is None:
+            return [], False
+        src_dpid, _ = src
+        dst_dpid, is_local_dst = dst
+        routes, truncated = self._shortest_routes(src_dpid, dst_dpid, max_paths)
+        return [
+            self._route_to_fdb(r, dst_mac, dst_dpid, is_local_dst) for r in routes
+        ], truncated
 
     def find_routes_batch(
         self, pairs: list[tuple[str, str]]
@@ -376,10 +391,14 @@ class TopologyDB:
             return self._jax_oracle().shortest_route(self, src_dpid, dst_dpid)
         return _py_shortest_route(self, src_dpid, dst_dpid)
 
-    def _shortest_routes(self, src_dpid: int, dst_dpid: int) -> list[list[int]]:
+    def _shortest_routes(
+        self, src_dpid: int, dst_dpid: int, max_paths: Optional[int] = None
+    ) -> tuple[list[list[int]], bool]:
         if self.backend == "jax":
-            return self._jax_oracle().all_shortest_routes(self, src_dpid, dst_dpid)
-        return _py_all_shortest_routes(self, src_dpid, dst_dpid)
+            return self._jax_oracle().all_shortest_routes(
+                self, src_dpid, dst_dpid, max_paths
+            )
+        return _py_all_shortest_routes(self, src_dpid, dst_dpid, max_paths)
 
     def _jax_oracle(self):
         if self._oracle is None:
@@ -439,23 +458,37 @@ def _py_shortest_route(db: TopologyDB, src_dpid: int, dst_dpid: int) -> list[int
 
 
 def _py_all_shortest_routes(
-    db: TopologyDB, src_dpid: int, dst_dpid: int
-) -> list[list[int]]:
+    db: TopologyDB, src_dpid: int, dst_dpid: int,
+    max_paths: Optional[int] = None,
+) -> tuple[list[list[int]], bool]:
+    """All equal-cost shortest paths, capped at ``max_paths``.
+
+    The path count is exponential in the worst case (a k-ary fat-tree
+    pair has (k/2)^2 equal-cost paths; richer DAGs explode further), so
+    enumeration stops — with ``truncated=True`` — once the cap is hit.
+    Every DAG branch leads to the destination (distance is strictly
+    decreasing), so work between emitted paths is bounded by the path
+    length: the cap bounds total time, not just output size. Returns
+    ``(routes, truncated)``.
+    """
     if src_dpid == dst_dpid:
-        return [[src_dpid]]
+        return [[src_dpid]], False
     dist = _py_dist_to(db, dst_dpid)
     if src_dpid not in dist:
-        return []
+        return [], False
 
     routes: list[list[int]] = []
-
-    def walk(node: int, acc: list[int]) -> None:
+    # explicit stack, reversed push order == sorted-dpid emission order
+    stack: list[list[int]] = [[src_dpid]]
+    while stack:
+        acc = stack.pop()
+        node = acc[-1]
         if node == dst_dpid:
             routes.append(acc)
-            return
-        for nxt in sorted(db.links.get(node, {})):
+            if max_paths is not None and len(routes) >= max_paths:
+                return routes, bool(stack)
+            continue
+        for nxt in sorted(db.links.get(node, {}), reverse=True):
             if dist.get(nxt, -1) == dist[node] - 1:
-                walk(nxt, acc + [nxt])
-
-    walk(src_dpid, [src_dpid])
-    return routes
+                stack.append(acc + [nxt])
+    return routes, False

@@ -198,37 +198,45 @@ class RouteOracle:
         return route
 
     def all_shortest_routes(
-        self, db: "TopologyDB", src_dpid: int, dst_dpid: int
-    ) -> list[list[int]]:
-        """Enumerate every equal-cost shortest path (sorted-dpid order).
+        self, db: "TopologyDB", src_dpid: int, dst_dpid: int,
+        max_paths: Optional[int] = None,
+    ) -> tuple[list[list[int]], bool]:
+        """Enumerate equal-cost shortest paths, capped at ``max_paths``.
 
-        Walks the shortest-path DAG defined by the cached distance matrix.
-        Materializing all paths is inherently exponential in the worst
-        case (the reference's BFS enumeration has the same property,
-        topology_db.py:86-122); device-side ECMP uses next-hop *sets*
-        instead (oracle/congestion.py) and never materializes this list.
+        Walks the shortest-path DAG defined by the cached distance
+        matrix. Materializing all paths is inherently exponential in the
+        worst case (the reference's BFS enumeration has the same
+        property, topology_db.py:86-122), so the walk stops — returning
+        ``truncated=True`` — once the cap is hit; since every DAG branch
+        reaches the destination, the cap bounds total work, not just
+        output size. Device-side ECMP uses next-hop *sets* instead
+        (oracle/congestion.py) and never materializes this list.
+        Returns ``(routes, truncated)``.
         """
         if src_dpid == dst_dpid:
-            return [[src_dpid]]
+            return [[src_dpid]], False
         t = self.refresh(db)
         si = t.index.get(src_dpid)
         di = t.index.get(dst_dpid)
         if si is None or di is None or not np.isfinite(self._dist[si, di]):
-            return []
+            return [], False
         dist = self._dist
         adj = np.asarray(t.adj) > 0
         routes: list[list[int]] = []
-
-        def walk(node: int, acc: list[int]) -> None:
+        stack: list[list[int]] = [[si]]
+        while stack:
+            acc = stack.pop()
+            node = acc[-1]
             if node == di:
                 routes.append([int(t.dpids[n]) for n in acc])
-                return
-            for nxt in np.nonzero(adj[node])[0]:
+                if max_paths is not None and len(routes) >= max_paths:
+                    return routes, bool(stack)
+                continue
+            # reversed push order == ascending-index emission order
+            for nxt in np.nonzero(adj[node])[0][::-1]:
                 if dist[nxt, di] == dist[node, di] - 1:
-                    walk(int(nxt), acc + [int(nxt)])
-
-        walk(si, [si])
-        return routes
+                    stack.append(acc + [int(nxt)])
+        return routes, False
 
     def _resolve_rows(
         self,
