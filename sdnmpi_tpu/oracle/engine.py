@@ -168,7 +168,9 @@ class RouteOracle:
 
                 tensors = tensorize(db, self.pad_multiple)
                 dist = apsp_distances(tensors.adj, self.max_diameter)
-                nxt = apsp_next_hops(tensors.adj, dist)
+                nxt = apsp_next_hops(
+                    tensors.adj, dist, max_degree=tensors.max_degree
+                )
                 self._tensors = tensors
                 self._dist_d = dist  # stays on device for route_collective
                 self._dist = np.asarray(dist)
@@ -178,6 +180,14 @@ class RouteOracle:
                 self._endpoint_memo = {}
                 self._version = db.version
         return self._tensors
+
+    @property
+    def dist_device(self):
+        """Device-resident ``[V, V]`` distance matrix of the last
+        ``refresh()`` (None before the first). Lets batch dispatchers
+        (bench configs, churn recovery) reuse the APSP the refresh
+        already paid for instead of recomputing it."""
+        return self._dist_d
 
     # -- queries ----------------------------------------------------------
 

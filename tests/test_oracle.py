@@ -77,6 +77,31 @@ def test_next_hop_blocking_invariance():
     np.testing.assert_array_equal(full, blocked)
 
 
+@pytest.mark.parametrize("seed", range(8))
+def test_next_hop_compact_matches_dense(seed):
+    """The degree-compact gather path (max_degree > 0, the production
+    churn fast path) must agree entry-for-entry with the dense O(V^3)
+    argmin, including tie-breaks, across random graphs, degree bounds
+    at/over the true max, and block splits."""
+    rng = np.random.default_rng(seed)
+    v = int(rng.integers(8, 40))
+    adj = (rng.random((v, v)) < float(rng.uniform(0.05, 0.4))).astype(
+        np.float32
+    )
+    np.fill_diagonal(adj, 0)
+    dist = apsp_distances(adj)
+    dense = np.asarray(apsp_next_hops(adj, dist))
+    true_deg = int((adj > 0).sum(axis=1).max())
+    for d in {max(1, true_deg), true_deg + 3, v, v + 5}:
+        compact = np.asarray(apsp_next_hops(adj, dist, max_degree=d))
+        np.testing.assert_array_equal(dense, compact, err_msg=f"D={d}")
+    if v % 2 == 0:
+        blocked = np.asarray(
+            apsp_next_hops(adj, dist, block=v // 2, max_degree=max(1, true_deg))
+        )
+        np.testing.assert_array_equal(dense, blocked)
+
+
 class TestBatchPaths:
     def setup_method(self):
         self.db = diamond(backend="jax")
