@@ -1,0 +1,211 @@
+"""Config 8: topology churn — link-flap storm on the flagship fat-tree.
+
+Every TopologyDB mutation bumps the version, and the next query pays
+the full oracle recovery: retensorize, APSP, next-hop matrix, neighbor
+table, endpoint-memo reset (oracle/engine.py refresh discipline). This
+config measures that recovery at the flagship scale (fat-tree k=28,
+980 switches padded to V=1024) under a storm of link flaps:
+
+- ``first_route_ms``: flap -> first single-pair route through the
+  production packet-in path (``RouteOracle.shortest_route``, which
+  triggers the full refresh). This is the reactive-routing recovery
+  bound — how long after a PORT_STATUS delete the controller can answer
+  its next packet-in with fresh topology.
+- headline value: flap -> full 4096-rank alltoall re-route (refresh +
+  one ``route_collective`` dispatch + result materialization). This is
+  the proactive-collective recovery bound — the elastic-failure axis of
+  SURVEY §5 at scale: a link dies mid-job and every flow of the
+  collective is re-balanced on the surviving fabric.
+
+The reference has no recovery path at all: a dead link neither
+invalidates installed flows nor re-routes anything (it never deletes
+flows; SURVEY §5), and its per-pair DFS (sdnmpi/util/topology_db.py:
+59-84) would pay the same 16.7M-pair cost as its steady state.
+vs_baseline follows bench.py's north-star logic: 50 ms budget /
+measured recovery (>1 means a flap costs less than one collective
+budget to absorb).
+
+The next-hop stage uses the degree-compact gather (apsp.py
+``max_degree``) — the dense O(V^3) argmin made mutation-to-first-route
+~10x slower at this scale.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+N_RANKS = 4096
+FATTREE_K = 28
+V_PAD = 1024
+N_FLAPS = 100
+TARGET_MS = 50.0
+ROUNDS = 2
+
+
+def build(k: int = FATTREE_K, v_pad: int = V_PAD, n_ranks: int = N_RANKS):
+    from sdnmpi_tpu.oracle.congestion import aggregate_pairs
+    from sdnmpi_tpu.oracle.dag import make_dst_nodes
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    db = spec.to_topology_db(backend="jax", pad_multiple=v_pad)
+    # the DB's own oracle: find_route and the collective phase must share
+    # one cache, or the storm would time a duplicate refresh per flap
+    oracle = db._jax_oracle()
+    t = oracle.refresh(db)
+
+    host_edge = np.array(
+        [t.index[dpid] for _, dpid, _ in spec.hosts[:n_ranks]], dtype=np.int32
+    )
+    src_sw = np.repeat(host_edge, n_ranks)
+    dst_sw = np.tile(host_edge, n_ranks)
+    keep = src_sw != dst_sw
+    usrc, udst, weight = aggregate_pairs(src_sw[keep], dst_sw[keep])
+    traffic = np.zeros((t.adj.shape[0],) * 2, np.float32)
+    traffic[udst, usrc] = weight
+    dst_nodes = make_dst_nodes(udst)
+    return spec, db, oracle, t, usrc, udst, traffic, dst_nodes
+
+
+def flap_storm(
+    db, oracle, t, usrc, udst, traffic, dst_nodes,
+    n_flaps: int = N_FLAPS, seed: int = 0,
+):
+    """Alternately delete and restore random switch-switch links; after
+    every mutation, measure first-route and full collective recovery.
+    Returns (first_route_ms, collective_ms) arrays of length n_flaps."""
+    import jax
+
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import route_collective
+
+    rng = np.random.default_rng(seed)
+    v = t.adj.shape[0]
+    macs = sorted(db.hosts)
+    pair = (macs[0], macs[-1])
+
+    # fixed per-collective inputs that do not depend on adjacency
+    src_d = jax.device_put(usrc)
+    dst_d = jax.device_put(udst)
+    traffic_d = jax.device_put(traffic)
+    dst_nodes_d = jax.device_put(dst_nodes)
+
+    dist0 = np.asarray(apsp_distances(t.adj))
+    # one level of slack over the intact diameter: a single-cable cut
+    # measurably grows a fat-tree's diameter by one (some switch pair
+    # loses its only 2-hop lane), and route_collective's levels bound is
+    # compiled static — without slack, post-flap long pairs would be
+    # silently dropped instead of routed long (asserted per flap below)
+    levels = int(np.nanmax(np.where(np.isfinite(dist0), dist0, np.nan))) + 1
+    max_len = levels + 1
+
+    def diameter_of(dist_d) -> int:
+        dh = np.asarray(dist_d)
+        return int(np.nanmax(np.where(np.isfinite(dh), dh, np.nan)))
+
+    def reroute_collective(tt, dist_d):
+        adj_host = np.asarray(tt.adj)
+        li, lj = np.nonzero(adj_host > 0)
+        util = np.zeros(len(li), np.float32)
+        buf = route_collective(
+            tt.adj, jax.device_put(li.astype(np.int32)),
+            jax.device_put(lj.astype(np.int32)), jax.device_put(util),
+            traffic_d, src_d, dst_d,
+            levels=levels, rounds=ROUNDS, max_len=max_len,
+            max_degree=tt.max_degree, dist=dist_d,
+            dst_nodes=dst_nodes_d,
+        )
+        return np.asarray(buf)
+
+    # a "flap" is a real link death: BOTH directed entries of the cable
+    # go (what a PORT_STATUS link-down does via the TopologyManager)
+    cables = [
+        (db.links[a][b], db.links[b][a])
+        for a in sorted(db.links) for b in sorted(db.links[a]) if a < b
+    ]
+    candidates = rng.choice(len(cables), size=n_flaps, replace=False)
+
+    def flap_down(cable):
+        for lk in cable:
+            db.delete_link(lk)
+
+    def flap_up(cable):
+        for lk in cable:
+            db.add_link(lk)
+
+    # compile every program shape before the storm (compile time is not
+    # churn): the full link count AND the post-delete count E-2 — the
+    # link arrays are an np.nonzero result, so their length is a traced
+    # shape and the first delete would otherwise recompile mid-storm
+    oracle.shortest_route(db, db.hosts[pair[0]].port.dpid,
+                          db.hosts[pair[1]].port.dpid)
+    reroute_collective(t, oracle.dist_device)
+    warm_cable = cables[int(candidates[0])]
+    flap_down(warm_cable)
+    tt = oracle.refresh(db)
+    reroute_collective(tt, oracle.dist_device)
+    flap_up(warm_cable)
+    oracle.refresh(db)
+
+    first_ms = np.zeros(n_flaps)
+    coll_ms = np.zeros(n_flaps)
+    removed = None
+    for i in range(n_flaps):
+        if removed is None:
+            removed = cables[int(candidates[i])]
+            flap_down(removed)
+        else:
+            flap_up(removed)  # restore: also a mutation, same cost
+            removed = None
+
+        t0 = time.perf_counter()
+        route = db.find_route(*pair)
+        first_ms[i] = (time.perf_counter() - t0) * 1e3
+        assert route, "flagship pair must stay routable through the storm"
+
+        tt = oracle.refresh(db)  # no-op: find_route already refreshed
+        reroute_collective(tt, oracle.dist_device)
+        coll_ms[i] = (time.perf_counter() - t0) * 1e3
+
+        # validation (untimed): route_collective's levels bound is
+        # static — a flap that grew the diameter past it would silently
+        # drop flows instead of routing them long
+        assert diameter_of(oracle.dist_device) <= levels, (
+            "flap grew the diameter past the compiled levels bound"
+        )
+    return first_ms, coll_ms
+
+
+def main() -> None:
+    from benchmarks.common import retry_backend_init
+
+    log(f"devices: {retry_backend_init()}")
+    t0 = time.perf_counter()
+    spec, db, oracle, t, usrc, udst, traffic, dst_nodes = build()
+    log(f"topology {spec.name}: {spec.n_switches} switches "
+        f"(padded {t.adj.shape[0]}), {len(usrc):,} aggregated flows "
+        f"[built in {time.perf_counter() - t0:.1f}s]")
+
+    first_ms, coll_ms = flap_storm(
+        db, oracle, t, usrc, udst, traffic, dst_nodes
+    )
+    log(f"{N_FLAPS} flaps: first-route median {np.median(first_ms):.2f} ms "
+        f"(p90 {np.percentile(first_ms, 90):.2f}, max {first_ms.max():.2f}); "
+        f"collective re-route median {np.median(coll_ms):.2f} ms "
+        f"(p90 {np.percentile(coll_ms, 90):.2f}, max {coll_ms.max():.2f})")
+
+    value = float(np.median(coll_ms))
+    emit(
+        "churn100_fattree1024_reroute_ms", value, "ms",
+        TARGET_MS / value,
+        first_route_ms=round(float(np.median(first_ms)), 3),
+        p90_ms=round(float(np.percentile(coll_ms, 90)), 3),
+    )
+
+
+if __name__ == "__main__":
+    main()

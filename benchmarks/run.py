@@ -32,6 +32,7 @@ CONFIGS = [
     ("5", [sys.executable, "-m", "benchmarks.config5_dragonfly"]),
     ("6", [sys.executable, "-m", "benchmarks.config6_fattree2048"]),
     ("7", [sys.executable, "-m", "benchmarks.config7_torus"]),
+    ("8", [sys.executable, "-m", "benchmarks.config8_churn"]),
 ]
 
 #: per-config wall clock cap (module-level so tests can shrink it)
