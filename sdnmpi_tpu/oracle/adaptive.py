@@ -281,10 +281,25 @@ def route_adaptive(
     weights, load, _ = balance_rounds(
         adj, dist, util, traffic, levels=levels, rounds=rounds
     )
-    nodes1, _ = sample_paths_dense(weights, dist, src, mid, max_len, salt=salt)
-    nodes2, _ = sample_paths_dense(
-        weights, dist, s2, d2, max_len, salt=salt ^ 0x5BD1E995
-    )
+    # sample only the free decisions (hop into dst is forced) and decode
+    # on device — the same contraction route_collective uses, with the
+    # fused Pallas sampler on TPU. The two segment batches were ~95% of
+    # this program's budget as full-length dense sampling (config 5).
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.oracle.dag import decode_slots_jax, sampled_hops
+
+    hops = sampled_hops(max_len)
+    f = src.shape[0]
+    salt2 = salt ^ 0x5BD1E995
+
+    if sampler_supported(v, hops, n_flows=f):
+        slots1 = sample_slots_pallas(weights, dist, src, mid, hops, salt=salt)
+        slots2 = sample_slots_pallas(weights, dist, s2, d2, hops, salt=salt2)
+    else:
+        _, slots1 = sample_paths_dense(weights, dist, src, mid, hops, salt=salt)
+        _, slots2 = sample_paths_dense(weights, dist, s2, d2, hops, salt=salt2)
+    nodes1 = decode_slots_jax(adj, slots1, src, mid)[:, :max_len]
+    nodes2 = decode_slots_jax(adj, slots2, s2, d2)[:, :max_len]
     return inter, nodes1, nodes2, load
 
 

@@ -456,6 +456,47 @@ def slots_to_nodes(adj, src, slots, dst=None, complete=False):
     return nodes
 
 
+def decode_slots_jax(
+    adj: jax.Array,  # [V, V] 0/1 (weights also accepted: > 0 = link)
+    slots: jax.Array,  # [F, H] int8 sampled slot streams
+    src: jax.Array,  # [F] int32 (-1 pad)
+    dst: jax.Array,  # [F] int32
+) -> jax.Array:
+    """Device-side ``slots -> nodes`` decode, the in-program counterpart
+    of ``native.decode_slots(..., complete=True)`` (same semantics,
+    differentially tested): walk the sorted-neighbor table for H slots,
+    append the final node and the forced last hop, whole row -1 when the
+    walk ends neither at dst nor adjacent to it. Returns [F, H + 2]
+    int32. Lets device pipelines (route_adaptive) consume the compact
+    int8 slot streams of the fused sampler while keeping a node-path
+    output contract.
+    """
+    v = adj.shape[0]
+    neigh, _, safe = neighbor_table(adj, v)  # full table: slots rank ALL neighbors
+    s32 = slots.astype(jnp.int32)  # [F, H]
+    valid = (s32[:, 0] >= 0) | (src == dst)
+    node0 = jnp.where(valid & (src >= 0), src, -1)
+
+    def step(node, s):
+        ok = (s >= 0) & (node >= 0) & (s < v)
+        nxt = neigh[jnp.maximum(node, 0), jnp.clip(s, 0, v - 1)]
+        return jnp.where(ok & (nxt < v), nxt, -1), node
+
+    last, emitted = lax.scan(step, node0, s32.T)  # emitted: [H, F] pre-move nodes
+    nodes = jnp.swapaxes(emitted, 0, 1)  # [F, H]
+    need = (last >= 0) & (last != dst)
+    adjacent = (
+        adj[jnp.maximum(last, 0), jnp.maximum(dst, 0)] > 0
+    ) & (last >= 0) & (dst >= 0)
+    forced = jnp.where(need & adjacent, dst, -1)
+    dead = need & ~adjacent
+    nodes = jnp.where(dead[:, None], -1, nodes)
+    last = jnp.where(dead, -1, last)
+    return jnp.concatenate(
+        [nodes, last[:, None], forced[:, None]], axis=1
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("levels", "rounds", "max_len", "max_degree", "salt"),

@@ -183,7 +183,12 @@ def route_adaptive_sharded(
         ugal_choose,
     )
     from sdnmpi_tpu.oracle.apsp import apsp_distances
-    from sdnmpi_tpu.oracle.dag import balance_rounds, sample_paths_dense
+    from sdnmpi_tpu.oracle.dag import (
+        balance_rounds,
+        decode_slots_jax,
+        sample_paths_dense,
+        sampled_hops,
+    )
 
     u = src.shape[0]
     n_shards = mesh.shape["flow"] * mesh.shape["v"]
@@ -244,10 +249,16 @@ def route_adaptive_sharded(
         weights, load, _ = balance_rounds(
             a, d, cost_util, traffic, levels=levels, rounds=rounds
         )
-        n1, _ = sample_paths_dense(weights, d, s, mid, max_len, fid_base=fid_base)
-        n2, _ = sample_paths_dense(
-            weights, d, s2, d2, max_len, salt=0x5BD1E995, fid_base=fid_base
+        # forced-hop elision + device decode, same contraction as the
+        # single-device route_adaptive (bit-identical nodes; the decode
+        # is pure XLA, so it shard_maps like the rest of the pipeline)
+        hops = sampled_hops(max_len)
+        _, sl1 = sample_paths_dense(weights, d, s, mid, hops, fid_base=fid_base)
+        _, sl2 = sample_paths_dense(
+            weights, d, s2, d2, hops, salt=0x5BD1E995, fid_base=fid_base
         )
+        n1 = decode_slots_jax(a, sl1, s, mid)[:, :max_len]
+        n2 = decode_slots_jax(a, sl2, s2, d2)[:, :max_len]
         return inter, n1, n2, load
 
     return inner(adj, dist_arg, util, src, dst, weight, jnp.int32(n_valid))

@@ -211,6 +211,69 @@ class TestForcedHopElision:
         )
         assert (nodes[0] == -1).all()
 
+    def test_device_decoder_matches_host_decoder(self):
+        """decode_slots_jax (the in-program decoder route_adaptive now
+        uses) must agree entry-for-entry with native.decode_slots
+        (complete=True) across random graphs and slot streams, including
+        garbage slots, pads, and dead walks."""
+        from sdnmpi_tpu.oracle.dag import decode_slots_jax
+
+        rng = np.random.default_rng(11)
+        for trial in range(10):
+            v = int(rng.integers(4, 24))
+            adj = (rng.random((v, v)) < 0.3).astype(np.float32)
+            np.fill_diagonal(adj, 0)
+            f, h = 48, int(rng.integers(1, 6))
+            src = rng.integers(-1, v, f).astype(np.int32)
+            dst = rng.integers(0, v, f).astype(np.int32)
+            # slot streams: mostly plausible ranks, some -1, some garbage
+            slots = rng.integers(-1, v + 2, (f, h)).astype(np.int8)
+            from sdnmpi_tpu import native
+
+            host = native.decode_slots(
+                slots, native.neighbor_order(adj), src, dst, complete=True
+            )
+            dev = np.asarray(decode_slots_jax(
+                jnp.asarray(adj), jnp.asarray(slots),
+                jnp.asarray(src), jnp.asarray(dst),
+            ))
+            np.testing.assert_array_equal(host, dev, err_msg=f"trial {trial}")
+
+    def test_elided_sampling_plus_decode_equals_full_dense(self):
+        """The route_adaptive contraction: sampling sampled_hops free
+        decisions and decoding (with the forced final hop) must yield
+        the same node paths as the old full-length dense sampling —
+        same hash streams, two fewer [F, V] hop stages."""
+        from sdnmpi_tpu.oracle.apsp import apsp_distances
+        from sdnmpi_tpu.oracle.dag import (
+            decode_slots_jax,
+            sample_paths_dense,
+            sampled_hops,
+        )
+
+        rng = np.random.default_rng(7)
+        for trial in range(6):
+            v = int(rng.integers(6, 20))
+            adj = (rng.random((v, v)) < 0.35).astype(np.float32)
+            np.fill_diagonal(adj, 0)
+            adj_j = jnp.asarray(adj)
+            dist = apsp_distances(adj_j)
+            w = jnp.asarray(adj * rng.random((v, v)).astype(np.float32))
+            f = 64
+            src = jnp.asarray(rng.integers(0, v, f).astype(np.int32))
+            dst = jnp.asarray(rng.integers(0, v, f).astype(np.int32))
+            max_len = int(np.nanmax(np.where(
+                np.isfinite(np.asarray(dist)), np.asarray(dist), np.nan
+            ))) + 1
+            full, _ = sample_paths_dense(w, dist, src, dst, max_len, salt=3)
+            _, slots = sample_paths_dense(
+                w, dist, src, dst, sampled_hops(max_len), salt=3
+            )
+            decoded = decode_slots_jax(adj_j, slots, src, dst)[:, :max_len]
+            np.testing.assert_array_equal(
+                np.asarray(full), np.asarray(decoded), err_msg=f"trial {trial}"
+            )
+
     def test_native_and_numpy_completion_agree(self, diamond_tensors):
         import sdnmpi_tpu.native as nat
 
