@@ -91,6 +91,27 @@ class TestSamplerKernel:
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
+    def test_bit_parity_on_adaptive_segment_batch(self, problem):
+        """route_adaptive's segment-2 batch has rows where BOTH src and
+        dst are -1 (minimal flows take no second segment) — the shape
+        the TPU branch feeds the fused sampler. Parity must hold there
+        too, and both samplers must park those rows entirely."""
+        from sdnmpi_tpu.kernels.sampler import sample_slots_pallas
+        from sdnmpi_tpu.oracle.dag import decode_slots_jax, sample_paths_dense
+
+        t, dist, weights, src, dst = problem
+        rng = np.random.default_rng(17)
+        detour = rng.random(len(np.asarray(src))) < 0.6
+        s2 = jnp.asarray(np.where(detour, np.asarray(src), -1))
+        d2 = jnp.asarray(np.where(detour, np.asarray(dst), -1))
+        _, ref = sample_paths_dense(weights, dist, s2, d2, 4, salt=5)
+        got = sample_slots_pallas(
+            weights, dist, s2, d2, 4, salt=5, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        nodes = np.asarray(decode_slots_jax(t.adj, got, s2, d2))
+        assert (nodes[~detour] == -1).all(), "parked rows must decode dead"
+
     def test_sampler_supported_gating(self):
         from sdnmpi_tpu.kernels.sampler import sampler_supported
 
