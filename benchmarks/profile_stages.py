@@ -123,9 +123,12 @@ def main(topo: str = "fattree:32", pad_multiple: int = 128) -> None:
             lambda: sample_slots_pallas(weights, dist, usrc, udst, hops)
         )
         log(f"sampler_pallas        {med:8.2f} ms  (best {best:.2f})")
-    med, best = _time(
+    # jit the wrapper: sample_paths_dense is a plain function, and an
+    # eager per-op run times dispatch, not the kernel
+    sam_xla = jax.jit(
         lambda: dag.sample_paths_dense(weights, dist, usrc, udst, hops)[1]
     )
+    med, best = _time(sam_xla)
     log(f"sampler_xla           {med:8.2f} ms  (best {best:.2f})")
 
     # -- destination-restricted variants (T = edge switches) -----------
@@ -173,7 +176,124 @@ def main(topo: str = "fattree:32", pad_multiple: int = 128) -> None:
     log(f"  dst-restricted      {med:8.2f} ms  (best {best:.2f})")
 
 
+def main_adaptive(topo: str = "dragonfly:8,32", n_flows: int = 10_000,
+                  pad_multiple: int = 8) -> None:
+    """Per-stage breakdown of the UGAL pipeline (config 5's program):
+    weighted DAG costs, UGAL choice, balance, the two segment samplers
+    (elided-hop, Pallas where supported), the device slot decode, and
+    the fused route_adaptive.
+
+    Usage: python -m benchmarks.profile_stages --adaptive [topo] [n_flows]
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
+    from sdnmpi_tpu.launch import parse_topo
+    from sdnmpi_tpu.oracle import adaptive
+
+    spec = parse_topo(topo)
+    db = spec.to_topology_db(backend="jax", pad_multiple=pad_multiple)
+    t = tensorize(db, pad_multiple=pad_multiple)
+    v = t.adj.shape[0]
+    n_real = t.n_real
+    log(f"{spec.name}: {spec.n_switches} switches, padded V={v}")
+
+    rng = np.random.default_rng(0)
+    src = jax.device_put(rng.integers(0, n_real, n_flows).astype(np.int32))
+    dst = jax.device_put(rng.integers(0, n_real, n_flows).astype(np.int32))
+    w = jax.device_put(np.ones(n_flows, np.float32))
+    util = jax.device_put(
+        (np.asarray(t.adj) > 0).astype(np.float32) * 4.0
+    )
+    n_valid = jnp.int32(n_real)
+
+    dist = apsp_distances(t.adj)
+    dist_h = np.asarray(dist)
+    levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
+    max_len = 2 * levels  # detour segments can each run up to the diameter
+    hops = dag.sampled_hops(max_len)
+    pallas = sampler_supported(v, hops, n_flows=n_flows)
+    log(f"{n_flows:,} flows, diameter {levels}, max_len {max_len}, "
+        f"sampled hops {hops}, sampler_pallas={pallas}")
+
+    cost_fn = jax.jit(lambda: adaptive.congestion_cost(t.adj, util))
+    cost = cost_fn()
+    med, best = _time(cost_fn)
+    log(f"congestion_cost       {med:8.2f} ms  (best {best:.2f})")
+
+    dmin = adaptive.dag_weighted_costs(
+        t.adj, dist, cost, levels=levels, max_degree=t.max_degree
+    )
+    med, best = _time(lambda: adaptive.dag_weighted_costs(
+        t.adj, dist, cost, levels=levels, max_degree=t.max_degree
+    ))
+    log(f"dag_weighted_costs    {med:8.2f} ms  (best {best:.2f})")
+
+    med, best = _time(lambda: adaptive.ugal_choose(
+        dmin, src, dst, n_valid, n_candidates=8, bias=1.0, salt=0
+    ))
+    log(f"ugal_choose (K=8)     {med:8.2f} ms  (best {best:.2f})")
+
+    inter = adaptive.ugal_choose(
+        dmin, src, dst, n_valid, n_candidates=8, bias=1.0, salt=0
+    )
+    detour = inter >= 0
+    mid = jnp.where(detour, inter, dst)
+    s2 = jnp.where(detour, mid, -1)
+    d2 = jnp.where(detour, dst, -1)
+    traffic = jnp.zeros((v, v), jnp.float32)
+    traffic = traffic.at[jnp.maximum(mid, 0), jnp.maximum(src, 0)].add(w)
+    traffic = traffic.at[jnp.maximum(d2, 0), jnp.maximum(s2, 0)].add(
+        jnp.where(detour, w, 0.0)
+    )
+
+    bal = jax.jit(lambda: dag.balance_rounds(
+        t.adj, dist, util, traffic, levels=levels, rounds=2
+    )[1])
+    med, best = _time(bal)
+    log(f"balance_rounds        {med:8.2f} ms  (best {best:.2f})")
+    weights, _, _ = dag.balance_rounds(
+        t.adj, dist, util, traffic, levels=levels, rounds=2
+    )
+    weights = jax.block_until_ready(weights)
+
+    if pallas:
+        med, best = _time(lambda: sample_slots_pallas(
+            weights, dist, src, mid, hops, salt=0
+        ))
+        log(f"segment sampler (pallas){med:6.2f} ms  (best {best:.2f})")
+    # jit the wrappers: these are plain functions, and an eager per-op
+    # run times dispatch, not the kernel
+    sam_xla = jax.jit(lambda: dag.sample_paths_dense(
+        weights, dist, src, mid, hops, salt=0
+    )[1])
+    med, best = _time(sam_xla)
+    log(f"segment sampler (xla) {med:8.2f} ms  (best {best:.2f})")
+
+    slots = jax.block_until_ready(sam_xla())
+    dec = jax.jit(lambda: dag.decode_slots_jax(t.adj, slots, src, mid))
+    med, best = _time(dec)
+    log(f"decode_slots_jax      {med:8.2f} ms  (best {best:.2f})")
+
+    def full():
+        return adaptive.route_adaptive(
+            t.adj, util, src, dst, w, n_valid, bias=1.0,
+            levels=levels, rounds=2, max_len=max_len, n_candidates=8,
+            max_degree=t.max_degree, dist=dist,
+        )[3]
+
+    med, best = _time(full)
+    log(f"route_adaptive fused  {med:8.2f} ms  (best {best:.2f})")
+
+
 if __name__ == "__main__":
-    topo = sys.argv[1] if len(sys.argv) > 1 else "fattree:32"
-    pad = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    main(topo, pad)
+    args = [a for a in sys.argv[1:] if a != "--adaptive"]
+    if "--adaptive" in sys.argv[1:]:
+        topo = args[0] if args else "dragonfly:8,32"
+        n_flows = int(args[1]) if len(args) > 1 else 10_000
+        main_adaptive(topo, n_flows)
+    else:
+        topo = args[0] if args else "fattree:32"
+        pad = int(args[1]) if len(args) > 1 else 128
+        main(topo, pad)
