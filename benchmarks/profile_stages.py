@@ -211,7 +211,10 @@ def main_adaptive(topo: str = "dragonfly:8,32", n_flows: int = 10_000,
     dist = apsp_distances(t.adj)
     dist_h = np.asarray(dist)
     levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
-    max_len = 2 * levels  # detour segments can each run up to the diameter
+    # per-SEGMENT bound: each segment is DAG-shortest, so at most the
+    # diameter — the production engine uses levels = max_len - 1
+    # (engine._adaptive_paths); stitched paths span up to 2*max_len - 1
+    max_len = levels + 1
     hops = dag.sampled_hops(max_len)
     pallas = sampler_supported(v, hops, n_flows=n_flows)
     log(f"{n_flows:,} flows, diameter {levels}, max_len {max_len}, "
@@ -271,10 +274,19 @@ def main_adaptive(topo: str = "dragonfly:8,32", n_flows: int = 10_000,
     med, best = _time(sam_xla)
     log(f"segment sampler (xla) {med:8.2f} ms  (best {best:.2f})")
 
+    # the fused program runs sampler + decode TWICE (both detour
+    # segments); time segment 2's sparser batch too so the stage sum
+    # accounts for the whole fused cost
+    sam2_xla = jax.jit(lambda: dag.sample_paths_dense(
+        weights, dist, s2, d2, hops, salt=0x5BD1E995
+    )[1])
+    med, best = _time(sam2_xla)
+    log(f"segment-2 sampler (xla){med:7.2f} ms  (best {best:.2f})")
+
     slots = jax.block_until_ready(sam_xla())
     dec = jax.jit(lambda: dag.decode_slots_jax(t.adj, slots, src, mid))
     med, best = _time(dec)
-    log(f"decode_slots_jax      {med:8.2f} ms  (best {best:.2f})")
+    log(f"decode_slots_jax (x2) {med:8.2f} ms  (best {best:.2f})")
 
     def full():
         return adaptive.route_adaptive(
