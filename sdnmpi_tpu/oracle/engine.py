@@ -167,7 +167,21 @@ class RouteOracle:
                 from sdnmpi_tpu import native
 
                 tensors = tensorize(db, self.pad_multiple)
-                dist = apsp_distances(tensors.adj, self.max_diameter)
+                mesh = self._dag_mesh()
+                if (
+                    mesh is not None
+                    and self.max_diameter == 0  # sharded BFS has no cap
+                    and mesh.shape["v"] > 1  # v=1 would just replicate
+                    and tensors.adj.shape[0] % mesh.shape["v"] == 0
+                ):
+                    # multi-chip refresh: the APSP (the refresh's device
+                    # cost) row-shards over the mesh's "v" axis, so
+                    # topology churn recovers at mesh scale too
+                    from sdnmpi_tpu.parallel.mesh import apsp_distances_sharded
+
+                    dist = apsp_distances_sharded(tensors.adj, mesh)
+                else:
+                    dist = apsp_distances(tensors.adj, self.max_diameter)
                 nxt = apsp_next_hops(
                     tensors.adj, dist, max_degree=tensors.max_degree
                 )

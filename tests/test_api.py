@@ -160,6 +160,36 @@ class TestRPCMirror:
         assert set(lk) == {"src", "dst"}
         assert set(lk["src"]) == {"dpid", "port_no", "hw_addr", "name"}
 
+    def test_wire_abi_roundtrip_fuzz(self):
+        """Any topology: the wire payload's hex fields must parse back
+        to the entity they encode, counts must match the DB, and every
+        payload must be pure JSON (no framework types leak through)."""
+        import random as _random
+
+        from sdnmpi_tpu.api import wire
+        from sdnmpi_tpu.topogen import dragonfly, fattree, torus
+
+        for spec in (fattree(4), torus((3, 3)), dragonfly(4, 8, 1, 2)):
+            db = spec.to_topology_db(backend="py")
+            topo = json.loads(json.dumps(wire.topology(db)))
+            assert len(topo["switches"]) == len(db.switches)
+            assert len(topo["hosts"]) == len(db.hosts)
+            assert len(topo["links"]) == sum(
+                len(m) for m in db.links.values()
+            )
+            rng = _random.Random(0)
+            for sw in rng.sample(topo["switches"], 3):
+                dpid = int(sw["dpid"], 16)
+                assert len(sw["dpid"]) == 16
+                entity = db.switches[dpid]
+                assert {int(p["port_no"], 16) for p in sw["ports"]} == {
+                    p.port_no for p in entity.ports
+                }
+            for lk in rng.sample(topo["links"], 3):
+                a = int(lk["src"]["dpid"], 16)
+                b = int(lk["dst"]["dpid"], 16)
+                assert b in db.links[a]
+
     def test_messages_are_json_serializable(self):
         fabric, controller, rpc = make_stack()
         client = FakeClient()

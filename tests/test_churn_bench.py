@@ -6,8 +6,6 @@ surviving fabric, and the degree-compact next-hop path (the churn
 optimization) agreeing with routing ground truth throughout.
 """
 
-import numpy as np
-
 from benchmarks.config8_churn import build, flap_storm
 
 

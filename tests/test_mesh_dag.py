@@ -241,3 +241,39 @@ def test_sharded_dag_cached_dist():
     )
     np.testing.assert_array_equal(np.asarray(slots_a), np.asarray(slots_b))
     np.testing.assert_allclose(float(maxc_a), float(maxc_b), rtol=1e-6)
+
+
+def test_refresh_sharded_apsp_matches_single_device():
+    """With mesh_devices configured, the oracle refresh row-shards its
+    APSP over the mesh; distances, next hops, and routes (including
+    after a churn mutation) must equal the single-device refresh."""
+    import numpy as np
+
+    from sdnmpi_tpu.core.topology_db import Link, Port
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(4)
+    dbs = {
+        n: spec.to_topology_db(backend="jax", pad_multiple=8)
+        for n in (0, N_SHARDS)
+    }
+    for n, db in dbs.items():
+        db.mesh_devices = n
+
+    oracles = {n: db._jax_oracle() for n, db in dbs.items()}
+    for n, db in dbs.items():
+        oracles[n].refresh(db)
+    np.testing.assert_array_equal(oracles[0]._dist, oracles[N_SHARDS]._dist)
+    np.testing.assert_array_equal(oracles[0]._next, oracles[N_SHARDS]._next)
+
+    # churn: cut one cable in both, re-route, same answer
+    macs = sorted(dbs[0].hosts)
+    a = next(iter(dbs[0].links))
+    b = next(iter(dbs[0].links[a]))
+    routes = {}
+    for n, db in dbs.items():
+        for x, y in ((a, b), (b, a)):
+            db.delete_link(Link(Port(x, db.links[x][y].src.port_no),
+                                Port(y, db.links[x][y].dst.port_no)))
+        routes[n] = db.find_route(macs[0], macs[-1])
+    assert routes[0] == routes[N_SHARDS] and routes[0]
