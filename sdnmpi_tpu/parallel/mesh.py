@@ -48,20 +48,14 @@ def make_mesh(n_devices: int) -> Mesh:
     return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
 
 
-def apsp_distances_sharded(adj: jax.Array, mesh: Mesh) -> jax.Array:
-    """Row-sharded BFS APSP: sources split across the "v" axis.
+@functools.lru_cache(maxsize=None)
+def _apsp_sharded_fn(mesh: Mesh, v: int):
+    """Cached jitted shard_map BFS for (mesh, V) — jax.jit caches per
+    function OBJECT, so building the closure per call would retrace and
+    recompile the whole multi-device program on every topology version
+    bump (the exact path churn recovery rides)."""
 
-    Functionally identical to oracle.apsp.apsp_distances; each shard runs
-    its own convergence loop (no collectives inside), so iteration count
-    is its local eccentricity bound.
-    """
-    v = adj.shape[0]
-    n_shards = mesh.shape["v"]
-    if v % n_shards:
-        raise ValueError(f"V={v} must divide by v-axis size {n_shards}")
-
-    eye = jnp.eye(v, dtype=jnp.float32)
-
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -90,7 +84,21 @@ def apsp_distances_sharded(adj: jax.Array, mesh: Mesh) -> jax.Array:
         )
         return dist
 
-    return block_bfs(adj, eye)
+    return block_bfs
+
+
+def apsp_distances_sharded(adj: jax.Array, mesh: Mesh) -> jax.Array:
+    """Row-sharded BFS APSP: sources split across the "v" axis.
+
+    Functionally identical to oracle.apsp.apsp_distances; each shard runs
+    its own convergence loop (no collectives inside), so iteration count
+    is its local eccentricity bound.
+    """
+    v = adj.shape[0]
+    n_shards = mesh.shape["v"]
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by v-axis size {n_shards}")
+    return _apsp_sharded_fn(mesh, v)(adj, jnp.eye(v, dtype=jnp.float32))
 
 
 def route_flows_sharded(

@@ -277,3 +277,24 @@ def test_refresh_sharded_apsp_matches_single_device():
                                 Port(y, db.links[x][y].dst.port_no)))
         routes[n] = db.find_route(macs[0], macs[-1])
     assert routes[0] == routes[N_SHARDS] and routes[0]
+
+
+def test_sharded_apsp_builder_is_cached():
+    """The shard_map BFS must be built once per (mesh, V): a fresh
+    closure per call would retrace + recompile the multi-device program
+    on every topology version bump (churn would become compile-bound)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sdnmpi_tpu.parallel import mesh as pm
+
+    m = pm.make_mesh(N_SHARDS)
+    rng = np.random.default_rng(0)
+    adj1 = jnp.asarray((rng.random((16, 16)) < 0.3).astype(np.float32))
+    adj2 = jnp.asarray((rng.random((16, 16)) < 0.3).astype(np.float32))
+    pm.apsp_distances_sharded(adj1, m)
+    before = pm._apsp_sharded_fn.cache_info()
+    pm.apsp_distances_sharded(adj2, m)  # new values, same (mesh, V)
+    after = pm._apsp_sharded_fn.cache_info()
+    assert after.hits == before.hits + 1
+    assert after.misses == before.misses
