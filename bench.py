@@ -119,7 +119,7 @@ def build_problem():
 
 
 def main() -> None:
-    from benchmarks.common import retry_backend_init
+    from benchmarks.common import init_backend
     from sdnmpi_tpu.oracle.dag import route_collective, slots_to_nodes, unpack_result
 
     import jax
@@ -127,7 +127,7 @@ def main() -> None:
     # transient UNAVAILABLE from the TPU plugin at init cost a round's
     # number once (BENCH_r02); bounded retry makes init failures loud
     # but not fatal
-    log(f"devices: {retry_backend_init()}")
+    init_backend()
     # dist_d: distances depend only on the topology — computed once per
     # topology version (the RouteOracle cache discipline), reused per
     # collective and by the validation below

@@ -152,6 +152,93 @@ def stream_throughput(dispatch_fetch, n_stream: int = 16, readers: int = 8,
     return min(window_ms), results, window_ms
 
 
+#: process-lifetime TPU lock handle (see acquire_tpu_lock)
+_TPU_LOCK_FD = None
+
+
+def tpu_lock_path() -> str:
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "log", "tpu.lock"
+    )
+
+
+def acquire_tpu_lock(timeout_s: float = 1800.0, hold: bool = True):
+    """Serialize TPU-touching processes on this machine.
+
+    The axon tunnel wedges when two processes touch it concurrently
+    (round 4 lost its entire evidence set to exactly that), so every
+    bench entry takes an exclusive flock on ``log/tpu.lock`` before its
+    first backend touch. ``hold=True`` (the default) keeps the lock for
+    the process lifetime — bench processes are short-lived and the OS
+    releases the flock on exit, even after a crash or kill. ``hold=False``
+    returns a handle with ``.release()`` for short sections (the
+    between-config probe). Re-acquisition in the same process is a
+    no-op. Raises TimeoutError after ``timeout_s`` so a stuck holder
+    produces a bounded, explicit failure instead of a silent stall.
+    """
+    import fcntl
+    import os
+
+    global _TPU_LOCK_FD
+    if _TPU_LOCK_FD is not None:
+        # this process already holds the lock for its lifetime; a second
+        # fd on the same file would CONFLICT under flock (open file
+        # descriptions are independent), so short-section acquires
+        # degrade to a no-op handle instead of self-deadlocking
+        if hold:
+            return _TPU_LOCK_FD
+
+        class _Held:
+            def release(self):
+                pass
+
+        return _Held()
+    path = tpu_lock_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = open(path, "w")
+    deadline = time.time() + timeout_s
+    warned = False
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError:
+            if time.time() > deadline:
+                fd.close()
+                raise TimeoutError(
+                    f"TPU lock {path} held by another process for "
+                    f"{timeout_s:.0f}s"
+                )
+            if not warned:
+                log(f"waiting for TPU lock {path} (another TPU process "
+                    "is running; serializing)")
+                warned = True
+            time.sleep(5)
+
+    class _Lock:
+        def __init__(self, f):
+            self._f = f
+
+        def release(self):
+            fcntl.flock(self._f, fcntl.LOCK_UN)
+            self._f.close()
+
+    lock = _Lock(fd)
+    if hold:
+        _TPU_LOCK_FD = lock
+    return lock
+
+
+def init_backend():
+    """The shared bench preamble: take the TPU lock, probe with bounded
+    retry, log the device list. One helper so the lock/init discipline
+    changes in one place (bench.py and every benchmarks/config* call
+    this first)."""
+    log(f"devices: {retry_backend_init()}")
+
+
 def _probe_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
     """Touch the accelerator from a KILLABLE subprocess.
 
@@ -207,6 +294,8 @@ def retry_backend_init(
     import threading
 
     import jax
+
+    acquire_tpu_lock()  # one TPU process at a time (held until exit)
 
     if os.environ.get("JAX_PLATFORMS"):
         # mirror the probe subprocess exactly: without this, probe and

@@ -18,6 +18,9 @@ N_RANKS = 8
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     spec = linear(4, hosts_per_switch=2)  # 8 hosts on 4 switches
     db_jax = spec.to_topology_db(backend="jax")
     db_py = spec.to_topology_db(backend="py")

@@ -41,6 +41,9 @@ def cpu_apsp(adj_list: list[list[int]]) -> np.ndarray:
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     spec = fattree(K)  # k=8: 16 agg + 16 edge + 16 core-ish (2-level pods)
     db = spec.to_topology_db(backend="jax")
     t = tensorize(db)

@@ -27,6 +27,9 @@ K = 16
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     import jax
 
     spec = fattree(K)

@@ -25,6 +25,9 @@ N_FLOWS = 10_000
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     import jax
     import jax.numpy as jnp
 

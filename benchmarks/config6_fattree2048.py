@@ -82,6 +82,9 @@ def _build(pad_multiple: int):
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     from sdnmpi_tpu.kernels.bfs import pallas_supported
     from sdnmpi_tpu.kernels.sampler import sampler_supported
 

@@ -41,6 +41,9 @@ HOSTS_PER_SWITCH = 19  # 216 * 19 = 4104 >= 4096
 
 
 def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
     import jax
 
     from sdnmpi_tpu.kernels.bfs import pallas_supported

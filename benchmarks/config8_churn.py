@@ -181,9 +181,9 @@ def flap_storm(
 
 
 def main() -> None:
-    from benchmarks.common import retry_backend_init
+    from benchmarks.common import init_backend
 
-    log(f"devices: {retry_backend_init()}")
+    init_backend()
     t0 = time.perf_counter()
     spec, db, oracle, t, usrc, udst, traffic, dst_nodes = build()
     log(f"topology {spec.name}: {spec.n_switches} switches "

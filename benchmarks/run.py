@@ -49,10 +49,24 @@ def _config_base(config_id: str) -> str:
 
 
 def probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> tuple[bool, str]:
-    """Killable-subprocess accelerator touch (see common.py rationale)."""
-    from benchmarks.common import _probe_backend_subprocess
+    """Killable-subprocess accelerator touch (see common.py rationale),
+    serialized by the TPU lock. A busy lock means another TPU process is
+    actively using the tunnel — evidence the backend is alive, not
+    wedged — so report healthy and let the configs' own locks serialize
+    the real work. This is safe because every holder is BOUNDED (config
+    subprocesses by CONFIG_TIMEOUT_S, the watcher's steps by explicit
+    `timeout`s), so even a holder that wedges mid-run releases the flock
+    when its bound kills it."""
+    from benchmarks.common import _probe_backend_subprocess, acquire_tpu_lock
 
-    return _probe_backend_subprocess(timeout_s)
+    try:
+        lock = acquire_tpu_lock(timeout_s=60, hold=False)
+    except TimeoutError:
+        return True, "lock busy: another TPU process is active"
+    try:
+        return _probe_backend_subprocess(timeout_s)
+    finally:
+        lock.release()
 
 
 def run_suite(

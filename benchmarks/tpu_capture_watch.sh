@@ -19,7 +19,10 @@ echo "[watch] $(date -u +%H:%M:%S) starting tunnel watch" | tee -a log/capture_w
 n=0
 while :; do
   n=$((n + 1))
-  if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" \
+  # flock waits OUTSIDE the probe timeout (a busy lock must not eat the
+  # jax.devices() budget); timeout applies to the backend touch only
+  if flock -w 600 log/tpu.lock \
+      timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" \
       >/dev/null 2>&1; then
     echo "[watch] $(date -u +%H:%M:%S) probe $n: tunnel ALIVE" \
       | tee -a log/capture_watch.log
@@ -47,7 +50,11 @@ echo "[watch] suite rc=$? -> BENCH_suite.json" | tee -a log/capture_watch.log
 
 echo "[watch] capture 3/3: real-Mosaic kernel parity" \
   | tee -a log/capture_watch.log
-SDNMPI_TEST_TPU=1 python -m pytest tests/test_kernels_tpu.py -v \
+# flock: bench entries serialize via log/tpu.lock (benchmarks/common.py);
+# the pytest run must join the same discipline — and be BOUNDED, so a
+# wedge mid-test can never hold the lock forever
+SDNMPI_TEST_TPU=1 flock -w 1800 log/tpu.lock \
+  timeout 1800 python -m pytest tests/test_kernels_tpu.py -v \
   >log/kernels_tpu_r05.log 2>&1
 echo "[watch] kernel parity rc=$? -> log/kernels_tpu_r05.log" \
   | tee -a log/capture_watch.log

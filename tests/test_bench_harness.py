@@ -9,6 +9,8 @@ partial re-runs instead of clobbering the suite file.
 """
 
 import json
+import pathlib
+import subprocess
 import sys
 
 import pytest
@@ -85,6 +87,57 @@ def test_suite_file_written_as_each_config_lands(tmp_path):
     )
     assert [r["config"] for r in rows] == ["1", "2"]
     assert rows[1]["value"] == 2.0  # the in-flight read saw config 1
+
+
+def test_tpu_lock_serializes_processes(tmp_path, monkeypatch):
+    """Two TPU-touching processes must serialize on the flock (the
+    round-4 wedge was exactly two concurrent tunnel clients): while one
+    holds it, another's bounded acquire must time out; release must let
+    it through."""
+    from benchmarks import common
+
+    lock_path = tmp_path / "tpu.lock"
+    monkeypatch.setattr(common, "tpu_lock_path", lambda: str(lock_path))
+    held = common.acquire_tpu_lock(timeout_s=5, hold=False)
+    try:
+        probe = [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, '.')\n"
+            "from benchmarks import common\n"
+            f"common.tpu_lock_path = lambda: {str(lock_path)!r}\n"
+            "try:\n"
+            "    common.acquire_tpu_lock(timeout_s=1, hold=False)\n"
+            "except TimeoutError:\n"
+            "    print('BLOCKED')\n"
+            "else:\n"
+            "    print('ACQUIRED')\n"
+        )]
+        out = subprocess.run(
+            probe, capture_output=True, text=True, cwd=pathlib.Path.cwd()
+        )
+        assert "BLOCKED" in out.stdout, out.stdout + out.stderr
+    finally:
+        held.release()
+    out = subprocess.run(
+        probe, capture_output=True, text=True, cwd=pathlib.Path.cwd()
+    )
+    assert "ACQUIRED" in out.stdout, out.stdout + out.stderr
+
+
+def test_tpu_lock_short_acquire_after_hold_is_noop(tmp_path, monkeypatch):
+    """A process that already holds the lifetime lock (retry_backend_init)
+    must not self-deadlock on a later short-section acquire — flock on a
+    second fd of the same file would conflict even within one process."""
+    from benchmarks import common
+
+    monkeypatch.setattr(common, "tpu_lock_path",
+                        lambda: str(tmp_path / "tpu.lock"))
+    held = common.acquire_tpu_lock(timeout_s=5)  # hold=True, lifetime
+    try:
+        short = common.acquire_tpu_lock(timeout_s=1, hold=False)
+        short.release()  # no-op handle; must return instantly, not raise
+    finally:
+        held.release()
+        monkeypatch.setattr(common, "_TPU_LOCK_FD", None)
 
 
 def test_partial_rerun_merges_not_clobbers(tmp_path):
