@@ -191,7 +191,7 @@ def utilization_matrix(
 ) -> np.ndarray:
     """Map the Monitor's (dpid, port_no) -> bps samples onto the [V, V]
     directed-link cost matrix using the topology's port map."""
-    port = np.asarray(tensors.port)
+    port = tensors.host_port()
     util = np.zeros(port.shape, np.float32)
     if not link_util:
         return util

@@ -173,3 +173,37 @@ def test_batch_fdb_matches_topology_db():
             (int(t.dpids[nodes[f, k]]), int(ports[f, k])) for k in range(length[f])
         ]
         assert got == expected, f"{a}->{b}: {got} != {expected}"
+
+
+def test_device_scatter_matrices_match_dense_upload():
+    """The compact edge-scatter upload path (tensorize's remote-device
+    branch) must produce bit-identical [V, V] matrices to the dense host
+    build, including pad-entry dropping and empty-edge topologies."""
+    from sdnmpi_tpu.oracle.engine import _device_matrices
+
+    rng = np.random.default_rng(23)
+    for trial in range(6):
+        v = int(rng.integers(4, 40))
+        n_edges = int(rng.integers(0, v * 3))
+        # unique (i, j) pairs — tensorize's edges come from a dict of
+        # dicts, so duplicates cannot occur (scatter order with
+        # duplicates is unspecified and NOT part of the contract)
+        flat = rng.choice(v * v, size=min(n_edges, v * v), replace=False)
+        li = (flat // v).astype(np.int32)
+        lj = (flat % v).astype(np.int32)
+        n_edges = len(flat)
+        ports = rng.integers(1, 64, n_edges).astype(np.int32)
+        # dense host reference
+        adj = np.zeros((v, v), np.float32)
+        port = np.full((v, v), -1, np.int32)
+        adj[li, lj] = 1.0
+        port[li, lj] = ports
+        # padded device scatter (pad entries indexed v -> dropped)
+        e_pad = max(n_edges + int(rng.integers(1, 9)), 1)
+        li_p = np.full(e_pad, v, np.int32)
+        lj_p = np.full(e_pad, v, np.int32)
+        pp = np.zeros(e_pad, np.int32)
+        li_p[:n_edges], lj_p[:n_edges], pp[:n_edges] = li, lj, ports
+        adj_d, port_d = _device_matrices(li_p, lj_p, pp, v)
+        np.testing.assert_array_equal(np.asarray(adj_d), adj, err_msg=f"t{trial}")
+        np.testing.assert_array_equal(np.asarray(port_d), port, err_msg=f"t{trial}")
