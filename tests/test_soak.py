@@ -1,0 +1,91 @@
+"""Lifecycle soak: repeated collective install/teardown leaves no residue.
+
+A controller that leaks per-cycle state — flow-table entries, FDB rows,
+collective-table records, cookie bookkeeping — would eventually wedge a
+long-running fabric. Eight full MPI job cycles (announce -> alltoall
+block install -> every rank exits) must return the fabric and every
+store to its steady state each time, with zero monotonic growth.
+The reference's closest behavior is the opposite: it never deletes any
+installed flow (SURVEY §2 defect), so its state grows without bound.
+"""
+
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from tests.test_collective_blocks import N_RANKS, kickoff, make_stack
+
+
+def _announce(fabric, mac, rank, ann_type):
+    fabric.hosts[mac].send(of.Packet(
+        eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff", eth_type=of.ETH_TYPE_IP,
+        ip_proto=of.IPPROTO_UDP, udp_dst=61000,
+        payload=Announcement(ann_type, rank).encode(),
+    ))
+
+
+def _state_size(fabric, controller):
+    return {
+        "flows": sum(len(sw.flow_table) for sw in fabric.switches.values()),
+        # block-set entries are the block engine's primary artifact
+        # (make_stack forces block_install_threshold=1)
+        "blocks": sum(len(sw.block_table) for sw in fabric.switches.values()),
+        "fdb": sum(1 for _ in controller.router.fdb.entries()),
+        "collectives": len(controller.router.collectives),
+        "ranks": len(controller.process_manager.rankdb),
+    }
+
+
+def test_repeated_job_cycles_leave_no_residue():
+    fabric, controller, macs = make_stack()
+    removed = []
+    controller.bus.subscribe(ev.EventCollectiveRemoved, removed.append)
+
+    baseline = None
+    for cycle in range(8):
+        if cycle > 0:  # make_stack announced the first generation
+            for rank, mac in enumerate(macs):
+                _announce(fabric, mac, rank, AnnouncementType.LAUNCH)
+        kickoff(fabric, macs)
+        busy = _state_size(fabric, controller)
+        assert busy["collectives"] == 1, busy
+        assert busy["blocks"] > 0, "block engine must have installed"
+
+        for rank, mac in enumerate(macs):
+            _announce(fabric, mac, rank, AnnouncementType.EXIT)
+
+        idle = _state_size(fabric, controller)
+        assert idle["collectives"] == 0
+        assert idle["blocks"] == 0
+        assert idle["ranks"] == 0
+        if baseline is None:
+            baseline = idle
+        else:
+            # steady state: byte-for-byte the same store sizes each cycle
+            assert idle == baseline, f"cycle {cycle}: {idle} != {baseline}"
+
+    assert len(removed) == 8  # one teardown per cycle, none skipped
+
+
+def test_cycles_with_churn_still_converge():
+    """Same soak with a link dying and recovering mid-cycle: the
+    teardown must still fully clean up (flow revalidation and collective
+    removal compose)."""
+    fabric, controller, macs = make_stack()
+    a, pa, b, pb = fabric.links[0]
+
+    baseline = None
+    for cycle in range(4):
+        if cycle > 0:
+            for rank, mac in enumerate(macs):
+                _announce(fabric, mac, rank, AnnouncementType.LAUNCH)
+        kickoff(fabric, macs)
+        fabric.remove_link(a, pa, b, pb)
+        fabric.add_link(a, pa, b, pb)
+        for rank, mac in enumerate(macs):
+            _announce(fabric, mac, rank, AnnouncementType.EXIT)
+        idle = _state_size(fabric, controller)
+        assert idle["collectives"] == 0 and idle["ranks"] == 0
+        if baseline is None:
+            baseline = idle
+        else:
+            assert idle == baseline, f"cycle {cycle}: {idle} != {baseline}"
