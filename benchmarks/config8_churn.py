@@ -108,8 +108,9 @@ def flap_storm(
         return int(np.nanmax(np.where(np.isfinite(dh), dh, np.nan)))
 
     def reroute_collective(tt, dist_d):
-        adj_host = np.asarray(tt.adj)
-        li, lj = np.nonzero(adj_host > 0)
+        # host twin: rebuilding the link vectors after a flap must not
+        # pull the dense matrix back over the tunnel
+        li, lj = np.nonzero(tt.host_adj() > 0)
         util = np.zeros(len(li), np.float32)
         buf = route_collective(
             tt.adj, jax.device_put(li.astype(np.int32)),

@@ -68,6 +68,15 @@ def apsp_distances(adj: jax.Array, max_diameter: int = 0) -> jax.Array:
     return dist
 
 
+def _fit_block(v: int, per_col_floats: int) -> int:
+    """Widest destination-column block dividing V whose broadcast
+    intermediate stays under ~256 MB (64M f32)."""
+    block = max(1, min(v, (1 << 26) // max(1, per_col_floats)))
+    while v % block:
+        block -= 1
+    return block
+
+
 def _nexthop_block(adj_mask: jax.Array, dist_block: jax.Array) -> jax.Array:
     """Next hops for a block of destination columns.
 
@@ -112,37 +121,27 @@ def apsp_next_hops(
         d = min(max_degree, v)
         _, valid, safe = neighbor_table(adj, max_degree)
 
-        if block == 0:
-            block = max(1, min(v, (1 << 26) // max(1, v * d)))
-            while v % block:
-                block -= 1
-
         def per_block(db):  # db: [B, V] rows = destinations
             cand = db.T[safe]  # [V, D, B] dist from each neighbor to dst
             cand = jnp.where(valid[:, :, None], cand, INF)
             k = jnp.argmin(cand, axis=1)  # [V, B] position in sorted table
             return jnp.take_along_axis(safe, k, axis=1)  # [V, B]
 
-        if block == v:
-            nxt = per_block(dist.T)
-        else:
-            blocks = lax.map(per_block, dist.T.reshape(v // block, block, v))
-            nxt = jnp.moveaxis(blocks, 0, 1).reshape(v, v)
+        per_col_floats = v * d
     else:
-        if block == 0:
-            block = max(1, min(v, (1 << 26) // max(1, v * v)))
-            while v % block:
-                block -= 1
-        if block == v:
-            nxt = _nexthop_block(adj_mask, dist)
-        else:
-            dist_blocks = dist.T.reshape(v // block, block, v)  # [nb, B, V]
 
-            def dense_block(db):
-                return _nexthop_block(adj_mask, db.T)  # [V, B]
+        def per_block(db):
+            return _nexthop_block(adj_mask, db.T)  # [V, B]
 
-            nxt = lax.map(dense_block, dist_blocks)  # [nb, V, B]
-            nxt = jnp.moveaxis(nxt, 0, 1).reshape(v, v)
+        per_col_floats = v * v
+
+    if block == 0:
+        block = _fit_block(v, per_col_floats)
+    if block == v:
+        nxt = per_block(dist.T)
+    else:
+        blocks = lax.map(per_block, dist.T.reshape(v // block, block, v))
+        nxt = jnp.moveaxis(blocks, 0, 1).reshape(v, v)
 
     idx = jnp.arange(v, dtype=jnp.int32)
     nxt = jnp.where(jnp.isinf(dist), -1, nxt)
