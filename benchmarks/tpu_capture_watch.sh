@@ -59,5 +59,13 @@ SDNMPI_TEST_TPU=1 flock -w 1800 log/tpu.lock \
 echo "[watch] kernel parity rc=$? -> log/kernels_tpu_r05.log" \
   | tee -a log/capture_watch.log
 
+echo "[watch] capture 4/4: UGAL stage profile (config-5 retune evidence)" \
+  | tee -a log/capture_watch.log
+flock -w 1800 log/tpu.lock timeout 1200 \
+  python -m benchmarks.profile_stages --adaptive \
+  >log/profile_adaptive_r05.log 2>&1
+echo "[watch] adaptive profile rc=$? -> log/profile_adaptive_r05.log" \
+  | tee -a log/capture_watch.log
+
 echo "[watch] $(date -u +%H:%M:%S) capture chain complete" \
   | tee -a log/capture_watch.log
