@@ -309,7 +309,11 @@ def stitch_paths(nodes1, nodes2, inter) -> np.ndarray:
     ``nodes1``/``nodes2`` [F, L] int32 (-1 padded), ``inter`` [F] int32.
     Returns [F, 2L - 1] int32: minimal flows keep segment 1 verbatim;
     detour flows append segment 2 minus its first node (the intermediate
-    appears once). Numpy only — this runs on the readback path.
+    appears once). Numpy only — this runs on the readback path, fully
+    vectorized (a per-detour python loop cost ~23 ms per 10k-flow batch,
+    comparable to the device program it postprocesses). Segment rows are
+    decoder outputs, so valid nodes form a contiguous PREFIX of each
+    row — the positional slice below relies on that invariant.
     """
     n1 = np.asarray(nodes1, np.int32)
     n2 = np.asarray(nodes2, np.int32)
@@ -318,10 +322,15 @@ def stitch_paths(nodes1, nodes2, inter) -> np.ndarray:
     out = np.full((f, 2 * l - 1), -1, np.int32)
     out[:, :l] = n1
     len1 = (n1 >= 0).sum(axis=1)
-    for i in np.nonzero(inter >= 0)[0]:
-        tail = n2[i][n2[i] >= 0]
-        if len(tail) > 1:
-            out[i, len1[i] : len1[i] + len(tail) - 1] = tail[1:]
+    len2 = (n2 >= 0).sum(axis=1)
+    j = np.arange(l - 1)
+    # detour rows with a real tail: copy n2[i, 1:len2[i]] to columns
+    # len1[i].. in one scatter
+    mask = (inter >= 0)[:, None] & (j[None, :] < (len2 - 1)[:, None])
+    if mask.any():
+        rows = np.nonzero(mask)[0]
+        cols = (len1[:, None] + j[None, :])[mask]
+        out[rows, cols] = n2[:, 1:][mask]
     return out
 
 

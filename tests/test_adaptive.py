@@ -377,3 +377,36 @@ class TestStitch:
         assert out.shape == (2, 7)
         assert list(out[0][out[0] >= 0]) == [0, 1, 2]
         assert list(out[1][out[1] >= 0]) == [0, 3, 4, 5]
+
+
+def test_stitch_paths_vectorized_matches_loop_reference():
+    """The vectorized stitch must equal the per-row loop on decoder-
+    shaped (prefix-valid) segment rows across random batches."""
+    def loop_reference(n1, n2, inter):
+        f, l = n1.shape
+        out = np.full((f, 2 * l - 1), -1, np.int32)
+        out[:, :l] = n1
+        len1 = (n1 >= 0).sum(axis=1)
+        for i in np.nonzero(inter >= 0)[0]:
+            tail = n2[i][n2[i] >= 0]
+            if len(tail) > 1:
+                out[i, len1[i]: len1[i] + len(tail) - 1] = tail[1:]
+        return out
+
+    rng = np.random.default_rng(31)
+    for trial in range(8):
+        f = int(rng.integers(1, 200))
+        l = int(rng.integers(2, 9))
+        def seg():
+            n = np.full((f, l), -1, np.int32)
+            lens = rng.integers(0, l + 1, f)
+            for i in range(f):  # prefix-valid rows, like the decoder emits
+                n[i, : lens[i]] = rng.integers(0, 64, lens[i])
+            return n
+        n1, n2 = seg(), seg()
+        inter = np.where(rng.random(f) < 0.6,
+                         rng.integers(0, 64, f), -1).astype(np.int32)
+        np.testing.assert_array_equal(
+            stitch_paths(n1, n2, inter), loop_reference(n1, n2, inter),
+            err_msg=f"trial {trial}",
+        )
