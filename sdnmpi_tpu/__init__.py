@@ -30,6 +30,6 @@ Package map:
   utils/        MAC helpers, tracing, logging
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"  # kept in sync with pyproject.toml
 
 from sdnmpi_tpu.config import Config  # noqa: F401

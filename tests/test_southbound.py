@@ -12,7 +12,6 @@ against the unchanged controller apps.
 import asyncio
 import struct
 
-import pytest
 
 from sdnmpi_tpu.config import Config
 from sdnmpi_tpu.control import events as ev
