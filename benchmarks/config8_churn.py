@@ -103,9 +103,17 @@ def flap_storm(
     levels = int(np.nanmax(np.where(np.isfinite(dist0), dist0, np.nan))) + 1
     max_len = levels + 1
 
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _finite_max(d):
+        return jnp.max(jnp.where(jnp.isfinite(d), d, -jnp.inf))
+
     def diameter_of(dist_d) -> int:
-        dh = np.asarray(dist_d)
-        return int(np.nanmax(np.where(np.isfinite(dh), dh, np.nan)))
+        # device-side reduce: the per-flap validation must not pull the
+        # [V, V] matrix over the tunnel (4 MB x 100 flaps of untimed
+        # wall clock)
+        return int(jax.device_get(_finite_max(dist_d)))
 
     def reroute_collective(tt, dist_d):
         # host twin: rebuilding the link vectors after a flap must not

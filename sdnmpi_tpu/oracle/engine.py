@@ -19,8 +19,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
-from sdnmpi_tpu.oracle.paths import batch_fdb
+from sdnmpi_tpu.oracle.paths import batch_fdb, batch_paths
 from sdnmpi_tpu.utils.tracing import STATS
+
+
+@jax.jit
+def _dist_span(dist, src, dst):
+    """(any reachable, max finite distance) over the selected pairs —
+    the device-side twin of ``_batch_max_len``'s host reduction, so a
+    batch dispatch never has to pull the [V, V] distance matrix to the
+    host just to size its hop budget (two scalars cross the link
+    instead of V^2 floats)."""
+    sel = dist[src, dst]
+    finite = jnp.isfinite(sel)
+    return finite.any(), jnp.max(jnp.where(finite, sel, -jnp.inf))
 
 
 def _timed_batch(op: str):
@@ -220,9 +232,10 @@ class RouteOracle:
         self._mesh = None  # lazily-built jax.sharding.Mesh
         self._version: Optional[int] = None
         self._tensors: Optional[TopoTensors] = None
-        self._dist: Optional[np.ndarray] = None
         self._dist_d = None  # device-resident distance matrix (jax.Array)
-        self._next: Optional[np.ndarray] = None
+        self._next_d = None  # device-resident next-hop matrix (jax.Array)
+        self._dist_h: Optional[np.ndarray] = None  # lazy host twin
+        self._next_h: Optional[np.ndarray] = None  # lazy host twin
         self._port: Optional[np.ndarray] = None
         self._order: Optional[np.ndarray] = None  # sorted-neighbor table
         #: mac -> (row index, final out-port) | None, valid for one
@@ -258,8 +271,14 @@ class RouteOracle:
                 )
                 self._tensors = tensors
                 self._dist_d = dist  # stays on device for route_collective
-                self._dist = np.asarray(dist)
-                self._next = np.asarray(nxt)
+                self._next_d = nxt
+                # the [V, V] dist/next host twins are LAZY (see the
+                # _dist/_next properties): downloading both eagerly cost
+                # ~8 MB per topology version over a remote-TPU link and
+                # dominated churn recovery (bench config 8); queries that
+                # never leave the device never pay it
+                self._dist_h = None
+                self._next_h = None
                 # host twins from tensorize: no dense-matrix readback
                 # over the device link on the churn-recovery path
                 self._port = tensors.host_port()
@@ -276,6 +295,37 @@ class RouteOracle:
         already paid for instead of recomputing it."""
         return self._dist_d
 
+    #: host-twin download budget: topologies whose [V, V] f32 matrix is
+    #: at or under this many bytes keep the eager-host behavior (the
+    #: download is cheap and the host chase is microseconds — benchmark
+    #: config 1); above it, host twins materialize only when a genuinely
+    #: host-side API (all_shortest_routes, matrices) asks, and the hot
+    #: query paths stay on device
+    host_twin_budget_bytes: int = 2 << 20
+
+    def _twins_cheap(self) -> bool:
+        return (
+            jax.default_backend() == "cpu"
+            or self._dist_d is None
+            or self._dist_d.size * 4 <= self.host_twin_budget_bytes
+        )
+
+    @property
+    def _dist(self) -> Optional[np.ndarray]:
+        """Host twin of the distance matrix, downloaded on first use per
+        topology version (see refresh)."""
+        if self._dist_h is None and self._dist_d is not None:
+            self._dist_h = np.asarray(self._dist_d)
+        return self._dist_h
+
+    @property
+    def _next(self) -> Optional[np.ndarray]:
+        """Host twin of the next-hop matrix, downloaded on first use per
+        topology version (see refresh)."""
+        if self._next_h is None and self._next_d is not None:
+            self._next_h = np.asarray(self._next_d)
+        return self._next_h
+
     # -- queries ----------------------------------------------------------
 
     def shortest_route(self, db: "TopologyDB", src_dpid: int, dst_dpid: int) -> list[int]:
@@ -285,7 +335,24 @@ class RouteOracle:
         t = self.refresh(db)
         si = t.index.get(src_dpid)
         di = t.index.get(dst_dpid)
-        if si is None or di is None or not np.isfinite(self._dist[si, di]):
+        if si is None or di is None:
+            return []
+        if self._next_h is None and not self._twins_cheap():
+            # large topology behind a remote link: chase the one pair on
+            # device and pull back only the [1, V] hop row instead of
+            # materializing the 2x[V, V] host twins (length 0 already
+            # encodes unreachable, so no separate distance fetch)
+            nodes, length = jax.device_get(batch_paths(
+                self._next_d,
+                jnp.asarray([si], jnp.int32),
+                jnp.asarray([di], jnp.int32),
+                t.v,
+            ))
+            n = int(length[0])
+            if n == 0:
+                return []
+            return [int(t.dpids[h]) for h in nodes[0, :n]]
+        if not np.isfinite(self._dist[si, di]):
             return []
         route = [src_dpid]
         node = si
@@ -512,11 +579,21 @@ class RouteOracle:
         the DAG fast path passes 1 because its per-hop [F, V] stages make
         every padded hop expensive and distinct diameters are few.
         0 means nothing is reachable."""
-        sel = self._dist[src_idx, dst_idx]
-        finite = np.isfinite(sel)
-        if not finite.any():
-            return 0
-        needed = int(sel[finite].max()) + 1
+        if self._dist_h is None and not self._twins_cheap():
+            any_f, mx = jax.device_get(_dist_span(
+                self._dist_d,
+                jnp.asarray(src_idx, jnp.int32),
+                jnp.asarray(dst_idx, jnp.int32),
+            ))
+            if not bool(any_f):
+                return 0
+            needed = int(mx) + 1
+        else:
+            sel = self._dist[src_idx, dst_idx]
+            finite = np.isfinite(sel)
+            if not finite.any():
+                return 0
+            needed = int(sel[finite].max()) + 1
         return ((needed + multiple - 1) // multiple) * multiple
 
     #: below this many total hops (pairs x path length), next-hop chasing
@@ -550,7 +627,12 @@ class RouteOracle:
         if max_len == 0:
             return results
 
-        if len(rows) * max_len <= self.host_chase_hop_budget:
+        # small batches chase on host — but only when the host twins are
+        # already (or cheaply) materialized; on a large topology behind a
+        # remote link the one-off [V, V] download costs far more than a
+        # device dispatch, so those batches go through batch_fdb instead
+        host_chase = self._next_h is not None or self._twins_cheap()
+        if host_chase and len(rows) * max_len <= self.host_chase_hop_budget:
             port_mat = self._port  # cached host copy: no device round-trip
             dpids = t.dpids
             for (k, si, di, fport) in rows:
@@ -567,7 +649,7 @@ class RouteOracle:
             return results
 
         nodes, ports, length = batch_fdb(
-            jnp.asarray(self._next),
+            self._next_d,
             t.port,
             jnp.asarray(src_idx),
             jnp.asarray(dst_idx),
@@ -1065,10 +1147,8 @@ class RouteOracle:
             )
             paths = stitch_paths(n1, n2, inter_h)
         elif policy == "shortest":
-            from sdnmpi_tpu.oracle.paths import batch_paths
-
             nodes, _ = batch_paths(
-                jnp.asarray(self._next),
+                self._next_d,
                 jnp.asarray(sub_src.astype(np.int32)),
                 jnp.asarray(sub_dst.astype(np.int32)),
                 max_len,

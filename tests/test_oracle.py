@@ -207,3 +207,72 @@ def test_device_scatter_matrices_match_dense_upload():
         adj_d, port_d = _device_matrices(li_p, lj_p, pp, v)
         np.testing.assert_array_equal(np.asarray(adj_d), adj, err_msg=f"t{trial}")
         np.testing.assert_array_equal(np.asarray(port_d), port, err_msg=f"t{trial}")
+
+
+class TestLazyHostTwins:
+    """The [V, V] dist/next host twins are lazy (engine.refresh): on a
+    remote accelerator they cost ~8 MB per topology version, which
+    dominated churn recovery (bench config 8). Forcing _twins_cheap()
+    to False exercises the exact remote-device code paths (device
+    chase, device hop-budget reduce) on the CPU backend and pins them
+    against the eager host paths."""
+
+    def _oracles(self):
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+
+        host = RouteOracle()
+        dev = RouteOracle()
+        dev._twins_cheap = lambda: False  # force the remote-device paths
+        return host, dev
+
+    def test_single_route_device_chase_matches_host(self):
+        from sdnmpi_tpu.topogen import fattree
+
+        db = fattree(4).to_topology_db(backend="jax")
+        host, dev = self._oracles()
+        switches = sorted(db.switches)
+        pairs = [(switches[0], switches[-1]), (switches[1], switches[7]),
+                 (switches[3], switches[3])]
+        for s, d in pairs:
+            assert dev.shortest_route(db, s, d) == host.shortest_route(db, s, d)
+        # the device chase must not have materialized the host twins
+        assert dev._next_h is None and dev._dist_h is None
+        assert host._next_h is not None  # eager path did
+
+    def test_unreachable_pair_device_chase(self):
+        db = diamond(backend="jax")
+        del db.links[1]  # cut switch 1's outgoing links
+        db._version += 1
+        host, dev = self._oracles()
+        assert dev.shortest_route(db, 1, 4) == []
+        assert host.shortest_route(db, 1, 4) == []
+        assert dev._next_h is None
+
+    def test_routes_batch_skips_host_chase(self):
+        """A batch small enough for the host chase must still route via
+        the device when the twins would cost a remote download."""
+        db = diamond(backend="jax")
+        host, dev = self._oracles()
+        macs = sorted(db.hosts)
+        pairs = [(macs[0], macs[-1]), (macs[-1], macs[0]), (macs[0], macs[0])]
+        assert dev.routes_batch(db, pairs) == host.routes_batch(db, pairs)
+        assert dev._next_h is None and dev._dist_h is None
+
+    def test_batch_max_len_device_reduce(self):
+        from sdnmpi_tpu.topogen import fattree
+
+        db = fattree(4).to_topology_db(backend="jax")
+        host, dev = self._oracles()
+        t = dev.refresh(db)
+        host.refresh(db)
+        v = t.adj.shape[0]
+        rng = np.random.default_rng(7)
+        src = rng.integers(0, t.n_real, 32).astype(np.int32)
+        dst = rng.integers(0, t.n_real, 32).astype(np.int32)
+        assert dev._batch_max_len(src, dst) == host._batch_max_len(src, dst)
+        # all-pad rows (unreachable): both report 0
+        pad = np.full(4, v - 1, np.int32)
+        if not np.isfinite(np.asarray(host._dist)[v - 1, 0]):
+            assert dev._batch_max_len(pad, np.zeros(4, np.int32)) == \
+                host._batch_max_len(pad, np.zeros(4, np.int32))
+        assert dev._dist_h is None
