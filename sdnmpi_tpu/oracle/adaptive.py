@@ -210,6 +210,7 @@ def ugal_choose(
     jax.jit,
     static_argnames=(
         "levels", "rounds", "max_len", "n_candidates", "salt", "max_degree",
+        "packed",
     ),
 )
 def route_adaptive(
@@ -227,6 +228,7 @@ def route_adaptive(
     salt: int = 0,
     max_degree: int = 32,
     dist: jax.Array | None = None,  # cached apsp_distances(adj), else computed
+    packed: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """UGAL + load-balanced DAG routing for a whole flow batch, one program.
 
@@ -240,6 +242,14 @@ def route_adaptive(
     load [V, V])`` — segment paths are stitched host-side by
     :func:`stitch_paths`; ``load`` is the fractional link-load matrix of
     the balanced assignment (its max is the congestion metric).
+
+    With ``packed=True`` the on-device decode is skipped and the two
+    segment results come back as the sampler's raw int8 slot streams
+    ``(inter, slots1 [F, H], slots2 [F, H], load)`` — ~10x fewer
+    readback bytes than the decoded int32 node rows, which is what a
+    remote-device link pays per batch (the device program itself is
+    ~9 ms at config-5 scale; readback dominated the measured batch
+    time). Decode host-side with :func:`decode_segments`.
 
     PRECONDITION: when ``dist`` is not supplied on TPU, ``levels`` must
     upper-bound the graph diameter — the fused Pallas BFS runs exactly
@@ -298,9 +308,47 @@ def route_adaptive(
     else:
         _, slots1 = sample_paths_dense(weights, dist, src, mid, hops, salt=salt)
         _, slots2 = sample_paths_dense(weights, dist, s2, d2, hops, salt=salt2)
+    if packed:
+        return inter, slots1, slots2, load
     nodes1 = decode_slots_jax(adj, slots1, src, mid)[:, :max_len]
     nodes2 = decode_slots_jax(adj, slots2, s2, d2)[:, :max_len]
     return inter, nodes1, nodes2, load
+
+
+def decode_segments(
+    adj_host, src, dst, inter, slots1, slots2, max_len: int,
+    order: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side decode of ``route_adaptive(packed=True)`` results.
+
+    Reconstructs the per-flow segment endpoints from ``inter`` exactly
+    as the device program derives them, then decodes both int8 slot
+    streams through the C++/numpy sorted-neighbor walker
+    (``native.decode_slots``, the differentially-tested twin of the
+    in-program ``decode_slots_jax``). Returns ``(nodes1, nodes2)``
+    [F, max_len] int32 — bit-identical to the unpacked return.
+
+    ``order`` is the precomputed sorted-neighbor table
+    (``native.neighbor_order(adj_host)``); callers that already cache
+    it per topology version (RouteOracle) pass it to keep the
+    O(V^2 log V) rebuild off the per-batch path.
+    """
+    from sdnmpi_tpu import native
+
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
+    inter = np.asarray(inter, np.int32)
+    detour = inter >= 0
+    mid = np.where(detour, inter, dst)
+    s2 = np.where(detour, mid, -1)
+    d2 = np.where(detour, dst, -1)
+    slots1 = np.asarray(slots1, np.int8)
+    slots2 = np.asarray(slots2, np.int8)
+    if order is None:
+        order = native.neighbor_order(adj_host)
+    n1 = native.decode_slots(slots1, order, src, mid, complete=True)
+    n2 = native.decode_slots(slots2, order, s2, d2, complete=True)
+    return n1[:, :max_len], n2[:, :max_len]
 
 
 def stitch_paths(nodes1, nodes2, inter) -> np.ndarray:

@@ -817,12 +817,24 @@ class RouteOracle:
                 t.n_real, mesh, **kwargs,
             )
         else:
-            inter, n1, n2, _ = route_adaptive(
+            from sdnmpi_tpu.oracle.adaptive import decode_segments
+
+            src_a = np.asarray(src_idx, np.int32)
+            dst_a = np.asarray(dst_idx, np.int32)
+            # packed readback: pull the int8 slot streams (not the
+            # decoded int32 node rows — ~10x the bytes) and decode
+            # through the host twin; bit-identical (tests/test_dag.py)
+            inter, s1, s2, _ = route_adaptive(
                 t.adj, jnp.asarray(base.astype(np.float32)),
-                jnp.asarray(np.asarray(src_idx, np.int32)),
-                jnp.asarray(np.asarray(dst_idx, np.int32)),
+                jnp.asarray(src_a), jnp.asarray(dst_a),
                 jnp.asarray(np.asarray(weight, np.float32)),
-                jnp.int32(t.n_real), **kwargs,
+                jnp.int32(t.n_real), packed=True, **kwargs,
+            )
+            inter = np.asarray(inter)
+            n1, n2 = decode_segments(
+                t.host_adj(), src_a, dst_a, inter,
+                np.asarray(s1), np.asarray(s2), max_len,
+                order=self._order,  # cached at refresh: no per-batch rebuild
             )
         return (
             np.asarray(inter)[:n], np.asarray(n1)[:n], np.asarray(n2)[:n],

@@ -320,3 +320,44 @@ class TestRouteCollective:
             path = nodes[f][nodes[f] >= 0]
             assert path[0] == src[f] and path[-1] == dst[f]
         assert 0.0 < maxc <= 2.0
+
+
+class TestPackedAdaptiveReadback:
+    def test_packed_route_adaptive_matches_unpacked(self):
+        """route_adaptive(packed=True) + host decode_segments must be
+        bit-identical to the unpacked device-decoded return — the
+        packed form is a readback-bytes optimization, not a different
+        computation (the remote-link motivation is documented on the
+        packed flag)."""
+        from sdnmpi_tpu.oracle.adaptive import decode_segments, route_adaptive
+        from sdnmpi_tpu.oracle.engine import tensorize
+        from sdnmpi_tpu.topogen import dragonfly
+
+        spec = dragonfly(4, 8, hosts_per_router=1, global_links=2)
+        db = spec.to_topology_db(backend="jax")
+        t = tensorize(db)
+        v = t.adj.shape[0]
+        rng = np.random.default_rng(3)
+        f = 400
+        src = rng.integers(0, t.n_real, f).astype(np.int32)
+        dst = rng.integers(0, t.n_real, f).astype(np.int32)
+        w = np.ones(f, np.float32)
+        util = (np.asarray(t.adj) > 0).astype(np.float32) * 4.0
+        kw = dict(levels=4, rounds=2, max_len=8, n_candidates=8,
+                  bias=1.0, max_degree=t.max_degree)
+        args = (t.adj, jnp.asarray(util), jnp.asarray(src),
+                jnp.asarray(dst), jnp.asarray(w), jnp.int32(t.n_real))
+
+        inter_u, n1_u, n2_u, load_u = route_adaptive(*args, **kw)
+        inter_p, s1, s2, load_p = route_adaptive(*args, packed=True, **kw)
+        np.testing.assert_array_equal(np.asarray(inter_u), np.asarray(inter_p))
+        np.testing.assert_array_equal(np.asarray(load_u), np.asarray(load_p))
+        n1_p, n2_p = decode_segments(
+            t.host_adj(), src, dst, np.asarray(inter_p),
+            np.asarray(s1), np.asarray(s2), kw["max_len"],
+        )
+        np.testing.assert_array_equal(np.asarray(n1_u), n1_p)
+        np.testing.assert_array_equal(np.asarray(n2_u), n2_p)
+        # slot streams really are the compact form (int8, sampled hops)
+        assert np.asarray(s1).dtype == np.int8
+        assert np.asarray(s1).shape[1] < np.asarray(n1_u).shape[1]
