@@ -53,7 +53,7 @@ N_MEAS = 16  # collectives per measurement window
 #: minutes (observed 12.6 ms and 40 ms for identical work an hour
 #: apart), so more cheap windows = better odds of sampling a quiet
 #: period; each window costs well under a second
-N_WINDOWS = 6
+N_WINDOWS = 10
 
 
 def log(msg: str) -> None:

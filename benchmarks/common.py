@@ -68,12 +68,19 @@ def alltoall_problem(spec, t, n_ranks: int):
     return usrc, udst, weight, n_ranks * n_ranks - int((counts**2).sum())
 
 
-def measure_route(route_fn, n_stream: int = 10):
+#: shared window count of the route-latency configs — one protocol
+#: knob, not per-config literals (tunnel jitter is bursty; every extra
+#: cheap window improves the odds of sampling a quiet period)
+ROUTE_WINDOWS = 5
+
+
+def measure_route(route_fn, n_stream: int = 10, windows: int = ROUTE_WINDOWS):
     """Compile + warm ``route_fn`` (device-buffer thunk), then measure a
     pipelined dispatch/fetch stream. Returns ``(ms_per_item,
     first_buffer_host, windows_ms)`` — the shared protocol of the
     route-latency configs; windows_ms is the per-window spread that
-    belongs next to every best-of figure (tunnel jitter is bursty)."""
+    belongs next to every best-of figure (tunnel jitter is bursty, so
+    more cheap windows = better odds of sampling a quiet period)."""
     first = np.asarray(route_fn())
     np.asarray(route_fn())
 
@@ -85,8 +92,10 @@ def measure_route(route_fn, n_stream: int = 10):
             pass
         return np.asarray(b)
 
-    ms, _, windows = stream_throughput(dispatch_fetch, n_stream=n_stream)
-    return ms, first, windows
+    ms, _, windows_ms = stream_throughput(
+        dispatch_fetch, n_stream=n_stream, windows=windows
+    )
+    return ms, first, windows_ms
 
 
 def naive_single_path_load(adj_dev, dist_dev, usrc, udst, weight, max_len, v):

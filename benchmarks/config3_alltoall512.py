@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from benchmarks.common import emit, log, stream_throughput
+from benchmarks.common import ROUTE_WINDOWS, emit, log, stream_throughput
 from sdnmpi_tpu.oracle.adaptive import link_loads
 from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
 from sdnmpi_tpu.oracle.congestion import aggregate_pairs
@@ -85,7 +85,7 @@ def main() -> None:
             pass
         return np.asarray(b)
 
-    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10, windows=ROUTE_WINDOWS)
     t_route = t_route_ms / 1e3
     slots, maxc = unpack_result(buf, len(usrc), max_len)
     nodes = slots_to_nodes(adj, usrc, slots, udst, complete=True)

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from benchmarks.common import emit, log, stream_throughput
+from benchmarks.common import ROUTE_WINDOWS, emit, log, stream_throughput
 from sdnmpi_tpu.oracle.adaptive import (
     decode_segments,
     link_loads,
@@ -97,7 +97,7 @@ def main() -> None:
     # run() warmups; warm it too or the first timed window pays its
     # compile (observed 322 ms vs 13.6 ms steady state)
     dispatch_fetch(-1)
-    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10)
+    t_route_ms, _, windows = stream_throughput(dispatch_fetch, n_stream=10, windows=ROUTE_WINDOWS)
     t_route = t_route_ms / 1e3
     inter_m, n1m, n2m = run(1e9)  # hysteresis so high UGAL never detours
 
