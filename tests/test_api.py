@@ -219,10 +219,21 @@ class TestWebSocketTransport:
             rpc = RPCInterface(controller.bus, config)
             controller.attach()
             server_task = asyncio.create_task(rpc.serve())
-            await asyncio.sleep(0.2)
             uri = f"ws://{config.rpc_host}:{config.rpc_port}{config.rpc_path}"
+            # retry until the server socket is listening: a fixed sleep
+            # races server startup on a loaded machine (observed flake)
+            for _ in range(100):
+                if server_task.done():
+                    server_task.result()  # surface the real bind error
+                try:
+                    ws = await websockets.connect(uri)
+                    break
+                except OSError:
+                    await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError("RPC server never started listening")
             messages = []
-            async with websockets.connect(uri) as ws:
+            async with ws:
                 # trigger an event after connect
                 await asyncio.sleep(0.1)
                 announce(fabric, MAC[1], AnnouncementType.LAUNCH, 3)
