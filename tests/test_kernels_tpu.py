@@ -248,3 +248,26 @@ def test_route_adaptive_pallas_branch_matches_dense(v=256):
     np.testing.assert_array_equal(np.asarray(n1), np.asarray(ref1))
     np.testing.assert_array_equal(np.asarray(n2), np.asarray(ref2))
     assert detour.any(), "adversarial shift must cause detours"
+
+    # packed readback (config 5's production path): the int8 slot
+    # streams decoded through the C++ host walker must reproduce the
+    # device-decoded nodes exactly, on the real Mosaic sampler output
+    from sdnmpi_tpu.oracle.adaptive import decode_segments
+
+    inter_p, ps1, ps2, load_p = route_adaptive(
+        t.adj, util, src, dst, w, jnp.int32(t.n_real), bias=1.0,
+        packed=True, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(inter), np.asarray(inter_p))
+    # packed/unpacked are distinct XLA executables (packed is a static
+    # arg); the float load matrix tolerates reduction-order drift while
+    # the integer route outputs below stay exact
+    np.testing.assert_allclose(
+        np.asarray(load), np.asarray(load_p), rtol=1e-6
+    )
+    p1, p2 = decode_segments(
+        t.host_adj(), np.asarray(src), np.asarray(dst),
+        np.asarray(inter_p), np.asarray(ps1), np.asarray(ps2), 8,
+    )
+    np.testing.assert_array_equal(np.asarray(n1), p1)
+    np.testing.assert_array_equal(np.asarray(n2), p2)
