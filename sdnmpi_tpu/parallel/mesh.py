@@ -162,6 +162,7 @@ def route_adaptive_sharded(
     bias: float = 1.0,
     max_degree: int = 32,
     dist: jax.Array | None = None,  # cached apsp_distances(adj), else computed
+    packed: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """UGAL adaptive routing with the flow batch sharded over ALL mesh
     devices (the "flow" x "v" axes flattened — the [V, V] state is small
@@ -184,6 +185,11 @@ def route_adaptive_sharded(
 
     Same return contract as ``route_adaptive``: (inter, nodes1, nodes2,
     load), with nodes/inter sharded over flows and load replicated.
+    ``packed=True`` skips the in-program decode and returns the int8
+    slot streams instead of node rows — the same ~10x readback-bytes
+    contraction the single-device path uses (oracle/adaptive.py), which
+    matters per host at pod scale; decode with
+    ``oracle.adaptive.decode_segments``.
     """
     from sdnmpi_tpu.oracle.adaptive import (
         congestion_cost,
@@ -265,6 +271,8 @@ def route_adaptive_sharded(
         _, sl2 = sample_paths_dense(
             weights, d, s2, d2, hops, salt=0x5BD1E995, fid_base=fid_base
         )
+        if packed:
+            return inter, sl1, sl2, load
         n1 = decode_slots_jax(a, sl1, s, mid)[:, :max_len]
         n2 = decode_slots_jax(a, sl2, s2, d2)[:, :max_len]
         return inter, n1, n2, load

@@ -298,3 +298,40 @@ def test_sharded_apsp_builder_is_cached():
     after = pm._apsp_sharded_fn.cache_info()
     assert after.hits == before.hits + 1
     assert after.misses == before.misses
+
+
+def test_sharded_adaptive_packed_matches_unpacked():
+    """route_adaptive_sharded(packed=True) + host decode_segments must
+    reproduce the sharded device-decoded nodes exactly — the mesh twin
+    of the single-device packed-readback contract (engine's mesh branch
+    ships slots, not node rows, per host)."""
+    from sdnmpi_tpu.oracle.adaptive import decode_segments
+    from sdnmpi_tpu.parallel.mesh import route_adaptive_sharded
+    from sdnmpi_tpu.topogen import dragonfly
+
+    spec = dragonfly(4, 4)
+    db = spec.to_topology_db(backend="jax", pad_multiple=8)
+    t = tensorize(db, pad_multiple=8)
+    mesh = make_mesh(N_SHARDS)
+    rng = np.random.default_rng(5)
+    f = 64  # divides 8 shards
+    src = rng.integers(0, t.n_real, f).astype(np.int32)
+    dst = rng.integers(0, t.n_real, f).astype(np.int32)
+    w = np.ones(f, np.float32)
+    util = (np.asarray(t.adj) > 0).astype(np.float32) * 2.0
+    args = (t.adj, jnp.asarray(util), jnp.asarray(src), jnp.asarray(dst),
+            jnp.asarray(w), t.n_real, mesh)
+    kw = dict(levels=4, max_len=8, rounds=2, n_candidates=4,
+              max_degree=t.max_degree)
+
+    inter_u, n1_u, n2_u, load_u = route_adaptive_sharded(*args, **kw)
+    inter_p, s1, s2, load_p = route_adaptive_sharded(*args, packed=True, **kw)
+    np.testing.assert_array_equal(np.asarray(inter_u), np.asarray(inter_p))
+    np.testing.assert_array_equal(np.asarray(load_u), np.asarray(load_p))
+    n1_p, n2_p = decode_segments(
+        t.host_adj(), src, dst, np.asarray(inter_p),
+        np.asarray(s1), np.asarray(s2), kw["max_len"],
+    )
+    np.testing.assert_array_equal(np.asarray(n1_u), n1_p)
+    np.testing.assert_array_equal(np.asarray(n2_u), n2_p)
+    assert np.asarray(s1).dtype == np.int8
