@@ -31,6 +31,11 @@ target from BASELINE.json: 50 ms. vs_baseline = 50 / measured (>1 beats
 the target).
 
 Prints exactly one JSON line on stdout; details go to stderr.
+
+``python bench.py churn`` runs the churn scenario instead (config 8:
+link-flap storm during a route stream, plus the incremental-repair vs
+full-recompute comparison) and prints its BENCH-format JSON lines — the
+same rows the suite driver collects as config 8.
 """
 
 from __future__ import annotations
@@ -215,4 +220,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "churn":
+        from benchmarks.config8_churn import main as churn_main
+
+        churn_main()
+    else:
+        main()

@@ -107,6 +107,28 @@ class Config:
     #: UGAL: detour hysteresis — a detour must beat the minimal DAG cost
     #: by more than this to be taken (idle fabrics route 100% minimal)
     ugal_bias: float = 1.0
+    #: incremental path oracle: when the TopologyDB's delta log covers
+    #: the gap since the oracle's cached version with at most this many
+    #: link-level deltas, the cached distance/next-hop tensors are
+    #: REPAIRED in place (oracle/incremental.py — one-pivot relaxation
+    #: for adds, column-restricted Jacobi re-relaxation for removes)
+    #: instead of rerunning the full Floyd–Warshall-style recompute.
+    #: Above the threshold — or when the delta log was broken by a
+    #: structural mutation — the full kernel runs. 0 disables repair.
+    delta_repair_threshold: int = 8
+    #: coalesce concurrent route lookups (unicast + MPI packet-ins)
+    #: into one padded batched oracle call instead of one device
+    #: dispatch per packet-in. Flushed when the southbound goes idle
+    #: (Fabric.on_idle), when the pending batch reaches
+    #: ``coalesce_max_batch``, or when ``coalesce_window_s`` elapses
+    #: between enqueues. Off by default: direct per-packet replies
+    #: preserve the reference's synchronous packet-in contract.
+    coalesce_routes: bool = False
+    #: pending-route count that forces an immediate coalescer flush
+    coalesce_max_batch: int = 256
+    #: max seconds a pending route lookup may wait for more batch
+    #: companions before an enqueue triggers the flush itself
+    coalesce_window_s: float = 0.005
 
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves

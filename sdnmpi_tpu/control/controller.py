@@ -34,6 +34,23 @@ class Controller:
         self.topology_manager = TopologyManager(self.bus, southbound, config)
         self.process_manager = ProcessManager(self.bus, southbound, config)
         self.router = Router(self.bus, southbound, config)
+        if config.coalesce_routes:
+            if hasattr(southbound, "on_idle"):
+                # route coalescing: the southbound's burst-drained edge
+                # flushes the Router's pending lookups as one batched
+                # oracle call (see Router.flush_routes)
+                southbound.on_idle = self.router.flush_routes
+                self.router.coalesce = True
+            else:
+                # never half-enable: without an idle edge a lone parked
+                # packet would wait forever for a batch companion
+                import logging
+
+                logging.getLogger("Controller").warning(
+                    "coalesce_routes is on but the southbound has no "
+                    "on_idle hook; falling back to direct per-packet "
+                    "route resolution"
+                )
         self.monitor: Optional[Monitor] = (
             Monitor(self.bus, southbound, config) if config.enable_monitor else None
         )

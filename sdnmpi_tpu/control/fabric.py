@@ -295,7 +295,7 @@ class SimHost:
         self.received: list[of.Packet] = []
 
     def send(self, pkt: of.Packet) -> None:
-        self.fabric.switches[self.dpid].receive(pkt, self.port_no, hops=0)
+        self.fabric.inject(self.dpid, pkt, self.port_no)
 
     def to_entity(self) -> Host:
         return Host(self.mac, Port(self.dpid, self.port_no))
@@ -323,6 +323,13 @@ class Fabric:
         self.links: list[tuple[int, int, int, int]] = []  # (a, pa, b, pb)
         self.bus = None  # set by connect()
         self.wire = wire
+        #: called whenever an ingress burst fully drains (every host
+        #: injection and its packet-in cascade has returned) and after
+        #: each tick — the hook the Router's route coalescer flushes
+        #: from, standing in for a real controller's event-loop idle
+        #: callback. None = no coalescing.
+        self.on_idle = None
+        self._ingress_depth = 0
         #: "direct" publishes EventLinkAdd/EventHostAdd itself;
         #: "packet" announces only what a real OF channel would (datapath
         #: up + port sets) and leaves links/hosts for the controller's
@@ -339,6 +346,26 @@ class Fabric:
     def _next_xid(self) -> int:
         self._xid += 1
         return self._xid
+
+    # -- ingress bursts ----------------------------------------------------
+
+    def inject(self, dpid: int, pkt: of.Packet, port_no: int) -> None:
+        """Deliver a data-plane frame arriving at a switch port and,
+        once the whole synchronous cascade (packet-ins, controller
+        replies, forwarded copies) has drained, signal ``on_idle``.
+        Nested deliveries (a controller packet-out re-entering the data
+        plane mid-burst) do not re-signal: one burst, one idle edge."""
+        self._ingress_depth += 1
+        try:
+            self.switches[dpid].receive(pkt, port_no, hops=0)
+        finally:
+            self._ingress_depth -= 1
+            if self._ingress_depth == 0:
+                self._notify_idle()
+
+    def _notify_idle(self) -> None:
+        if self.on_idle is not None:
+            self.on_idle()
 
     # -- construction -----------------------------------------------------
 
@@ -456,6 +483,9 @@ class Fabric:
             sw.flow_table = [e for e in sw.flow_table if id(e) not in doomed]
             for e, reason in expired:
                 self._flow_removed(dpid, e, reason)
+        # time passed: any coalesced route lookups past their window
+        # must not wait for the next data-plane burst
+        self._notify_idle()
 
     def _flow_removed(self, dpid: int, e: _FlowEntry, reason: int) -> None:
         if self.bus is None:

@@ -19,7 +19,9 @@ tears down the flows addressed to its rank's virtual MAC.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import time
 
 from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
 from sdnmpi_tpu.control import events as ev
@@ -31,6 +33,21 @@ from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac, is_sdn_mpi_addr
 from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
 
 log = logging.getLogger("Router")
+
+
+@dataclasses.dataclass
+class _PendingRoute:
+    """One packet-in's route lookup parked in the coalescer: the match
+    pair, the true destination (MPI virtual-MAC flows), and everything
+    needed to finish the packet's handling after the batched reply."""
+
+    src: str
+    dst: str  # match destination (virtual MAC for MPI flows)
+    true_dst: str | None  # rewrite target; None = plain unicast
+    dpid: int
+    in_port: int
+    pkt: of.Packet
+    buffer_id: int
 
 
 class Router:
@@ -50,6 +67,19 @@ class Router:
         self.collectives = CollectiveTable()
         #: live datapaths (reference: router.py:69-81 keeps self.dps)
         self.dps: set[int] = set()
+        #: route-request coalescer (Config.coalesce_routes): packet-in
+        #: lookups park here and resolve as ONE padded batched oracle
+        #: call per flush instead of one device dispatch each — the
+        #: device round-trip amortizes across the burst, and the padded
+        #: batch rides the oracle's bucketed jit cache. The live switch
+        #: is this attribute, not the config flag: the composition root
+        #: (Controller) arms it only when the southbound provides an
+        #: idle edge to flush from, so a lone packet can never strand
+        #: in the queue waiting for a companion that never comes.
+        self.coalesce: bool = False
+        self._pending: list[_PendingRoute] = []
+        self._pending_t0 = 0.0
+        self._flushing = False
 
         bus.subscribe(ev.EventDatapathUp, lambda e: self.dps.add(e.dpid))
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -158,6 +188,8 @@ class Router:
 
         log.info("Packet in at %s (%s) %s -> %s", event.dpid, event.in_port, src, dst)
 
+        if self.coalesce:
+            return self._enqueue_route(src, dst, None, event)
         fdb = self.bus.request(ev.FindRouteRequest(src, dst)).fdb
         if fdb:
             self._add_flows_for_path(fdb, src, dst)
@@ -181,13 +213,72 @@ class Router:
         if not true_dst:
             return  # unresolved rank -> drop (reference: router.py:186-187)
 
-        fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
-        if fdb:
-            self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
-            self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
+        if self.coalesce:
+            self._enqueue_route(pkt.eth_src, pkt.eth_dst, true_dst, event)
+        else:
+            fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
+            if fdb:
+                self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
+                self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
 
         if self.config.proactive_collectives and vmac.coll_type != CollectiveType.P2P:
             self._install_collective(vmac)
+
+    # -- route-request coalescing (no reference equivalent) ---------------
+
+    def _enqueue_route(
+        self, src: str, dst: str, true_dst: str | None, event: ev.EventPacketIn
+    ) -> None:
+        """Park one packet-in's route lookup for batched resolution.
+
+        Flush triggers: the pending batch reaching
+        ``Config.coalesce_max_batch``, or ``Config.coalesce_window_s``
+        elapsing since the batch opened. The southbound's idle edge
+        (Fabric.on_idle -> :meth:`flush_routes`) bounds the wait: a
+        burst is always resolved before control returns to the caller
+        that injected it, so coalescing never strands a packet."""
+        if not self._pending:
+            self._pending_t0 = time.monotonic()
+        self._pending.append(_PendingRoute(
+            src, dst, true_dst, event.dpid, event.in_port, event.pkt,
+            event.buffer_id,
+        ))
+        if not self._flushing and (
+            len(self._pending) >= self.config.coalesce_max_batch
+            or time.monotonic() - self._pending_t0
+            >= self.config.coalesce_window_s
+        ):
+            self.flush_routes()
+
+    def flush_routes(self) -> None:
+        """Resolve every pending route lookup in one batched oracle
+        call per ``coalesce_max_batch`` slice, then finish each parked
+        packet exactly as the direct path would (install + packet-out,
+        or controlled broadcast for routeless unicast). Loops until the
+        queue drains: packet-outs re-entering the data plane may park
+        new lookups mid-flush."""
+        if self._flushing:
+            return
+        self._flushing = True
+        try:
+            while self._pending:
+                batch = self._pending[: self.config.coalesce_max_batch]
+                del self._pending[: len(batch)]
+                pairs = [(p.src, p.true_dst or p.dst) for p in batch]
+                reply = self.bus.request(ev.FindRoutesBatchRequest(pairs))
+                for p, fdb in zip(batch, reply.fdbs):
+                    if fdb:
+                        self._add_flows_for_path(fdb, p.src, p.dst, p.true_dst)
+                        self._send_packet_out(fdb, p.dpid, p.pkt, p.buffer_id)
+                    elif p.true_dst is None:
+                        # routeless unicast falls back to controlled
+                        # broadcast; routeless MPI flows drop, exactly
+                        # like the direct path (reference: router.py:186)
+                        self.bus.request(
+                            ev.BroadcastRequest(p.pkt, p.dpid, p.in_port)
+                        )
+        finally:
+            self._flushing = False
 
     def _install_collective(self, vmac: VirtualMac) -> None:
         """Pre-route the whole collective in one load-balanced batch.

@@ -47,6 +47,7 @@ class TopologyManager:
             pad_multiple=config.switch_pad_multiple,
             max_diameter=config.max_diameter,
             mesh_devices=config.mesh_devices,
+            delta_repair_threshold=config.delta_repair_threshold,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed
         #: link in bps: max of the sender's tx stream and the receiver's

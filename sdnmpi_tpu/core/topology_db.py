@@ -99,6 +99,13 @@ def _entity_dict(obj: Any) -> Any:
     return out
 
 
+#: delta-log depth: enough to cover any burst the oracle would repair
+#: incrementally (Config.delta_repair_threshold plus the switch-upsert
+#: chatter cabling changes produce) with a wide margin; overflow just
+#: advances the floor, forcing the next refresh down the full path
+_DELTA_LOG_CAP = 64
+
+
 class TopologyDB:
     def __init__(
         self,
@@ -106,6 +113,7 @@ class TopologyDB:
         pad_multiple: int = 8,
         max_diameter: int = 0,
         mesh_devices: int = 0,
+        delta_repair_threshold: Optional[int] = None,
     ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
@@ -118,42 +126,89 @@ class TopologyDB:
         self.pad_multiple = pad_multiple
         self.max_diameter = max_diameter
         self.mesh_devices = mesh_devices
+        #: max link deltas the oracle absorbs by in-place repair before
+        #: a full recompute (None = RouteOracle's default; 0 disables)
+        self.delta_repair_threshold = delta_repair_threshold
         self._version = 0
         self._oracle = None  # lazily-created JAX oracle (oracle/engine.py)
+        #: epoch + dirty-set log for the incremental oracle: one entry
+        #: per version bump, ``(version, kind, ...)`` — see
+        #: :meth:`deltas_since`. Structural mutations the repair path
+        #: cannot express (switch deletion) break the log instead.
+        self._delta_log: list[tuple] = []
+        #: deltas at versions <= the floor are unknown (pre-history,
+        #: log overflow, or a structural break)
+        self._delta_floor = 0
 
     # -- mutators (reference: sdnmpi/util/topology_db.py:20-42) ----------
+
+    def _log_delta(self, *entry) -> None:
+        self._delta_log.append((self._version, *entry))
+        if len(self._delta_log) > _DELTA_LOG_CAP:
+            self._delta_floor = self._delta_log.pop(0)[0]
+
+    def _break_deltas(self) -> None:
+        self._delta_log.clear()
+        self._delta_floor = self._version
 
     def add_host(self, host: Any) -> None:
         self.hosts[host.mac] = host
         self._version += 1
+        self._log_delta("host", host.port.dpid)
 
     def delete_host(self, mac: str) -> None:
-        if self.hosts.pop(mac, None) is not None:
+        host = self.hosts.pop(mac, None)
+        if host is not None:
             self._version += 1
+            self._log_delta("host", host.port.dpid)
 
     def add_switch(self, switch: Any) -> None:
+        known = switch.dp.id in self.switches
         self.switches[switch.dp.id] = switch
         self._version += 1
+        # an upsert (port-set refresh of a known dpid — what every
+        # cabling change produces via EventPortAdd) never changes the
+        # routed graph; a genuinely new switch may grow the node set
+        self._log_delta(
+            "switch_upsert" if known else "switch_new", switch.dp.id
+        )
 
     def delete_switch(self, switch: Any) -> None:
         if switch.dp.id in self.switches:
             del self.switches[switch.dp.id]
             self._version += 1
+            self._break_deltas()  # node set may shrink: full recompute
 
     def add_link(self, link: Any) -> None:
         self.links.setdefault(link.src.dpid, {})[link.dst.dpid] = link
         self._version += 1
+        self._log_delta(
+            "link+", link.src.dpid, link.dst.dpid, link.src.port_no
+        )
 
     def delete_link(self, link: Any) -> None:
         dst_map = self.links.get(link.src.dpid)
         if dst_map and link.dst.dpid in dst_map:
             del dst_map[link.dst.dpid]
             self._version += 1
+            self._log_delta("link-", link.src.dpid, link.dst.dpid)
 
     @property
     def version(self) -> int:
         """Bumped on every mutation; oracle caches are keyed on this."""
         return self._version
+
+    def deltas_since(self, version: int) -> Optional[list[tuple]]:
+        """Every mutation after ``version``, as ``(version, kind, ...)``
+        tuples — ``("link+", src, dst, port)`` / ``("link-", src, dst)``
+        link deltas plus ``switch_upsert`` / ``switch_new`` / ``host``
+        membership markers — or None when the log no longer covers that
+        epoch (overflow or a structural break). The incremental oracle
+        (oracle/incremental.py) repairs its tensors from this instead
+        of recomputing the full APSP."""
+        if version < self._delta_floor:
+            return None
+        return [e for e in self._delta_log if e[0] > version]
 
     def to_dict(self) -> dict:
         """JSON snapshot, same layout as the reference's
@@ -408,6 +463,10 @@ class TopologyDB:
                 self.pad_multiple, self.max_diameter,
                 mesh_devices=self.mesh_devices,
             )
+            if self.delta_repair_threshold is not None:
+                self._oracle.delta_repair_threshold = (
+                    self.delta_repair_threshold
+                )
         return self._oracle
 
 

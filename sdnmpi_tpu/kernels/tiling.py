@@ -16,3 +16,19 @@ def col_block(v: int) -> int:
         if v % c == 0:
             return c
     return v
+
+
+def col_bucket(n: int, v: int) -> int:
+    """Pad a dynamic column count to a bounded ladder of jit shapes.
+
+    The incremental APSP repair (oracle/incremental.py) operates on the
+    delta's dirty destination columns — a count that varies per link
+    flap. Tracing one kernel per distinct count would grow the jit
+    cache without bound under churn, so counts round up to the next
+    power of two (floor 8), capped at ``v`` (the full-width recompute):
+    at most ``log2(v/8) + 2`` shapes ever compile per (V, max_degree).
+    """
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, v)

@@ -89,6 +89,24 @@ def _nexthop_block(adj_mask: jax.Array, dist_block: jax.Array) -> jax.Array:
     return jnp.argmin(scores, axis=1).astype(jnp.int32)
 
 
+def _degree_compact_block(
+    valid: jax.Array, safe: jax.Array, dist_block: jax.Array
+) -> jax.Array:
+    """Degree-compact next hops for a ``[V, B]`` block of destination
+    columns: gather each node's sorted-neighbor distances and argmin.
+
+    The single implementation shared by the full recompute
+    (:func:`apsp_next_hops`) and the incremental column repair
+    (:func:`nexthop_cols`), so the lowest-index tie-break — load-bearing
+    for reference parity AND for the repair's bit-for-bit equivalence
+    with a from-scratch recompute — can never drift between the two.
+    """
+    cand = dist_block[safe]  # [V, D, B]: dist from each neighbor to dst
+    cand = jnp.where(valid[:, :, None], cand, INF)
+    k = jnp.argmin(cand, axis=1)  # [V, B] position in sorted table
+    return jnp.take_along_axis(safe, k, axis=1)  # [V, B]
+
+
 @functools.partial(jax.jit, static_argnames=("block", "max_degree"))
 def apsp_next_hops(
     adj: jax.Array, dist: jax.Array, block: int = 0, max_degree: int = 0
@@ -122,10 +140,7 @@ def apsp_next_hops(
         _, valid, safe = neighbor_table(adj, max_degree)
 
         def per_block(db):  # db: [B, V] rows = destinations
-            cand = db.T[safe]  # [V, D, B] dist from each neighbor to dst
-            cand = jnp.where(valid[:, :, None], cand, INF)
-            k = jnp.argmin(cand, axis=1)  # [V, B] position in sorted table
-            return jnp.take_along_axis(safe, k, axis=1)  # [V, B]
+            return _degree_compact_block(valid, safe, db.T)
 
         per_col_floats = v * d
     else:
@@ -147,3 +162,56 @@ def apsp_next_hops(
     nxt = jnp.where(jnp.isinf(dist), -1, nxt)
     nxt = jnp.where(idx[:, None] == idx[None, :], idx[:, None], nxt)
     return nxt
+
+
+@functools.partial(jax.jit, static_argnames=("max_degree",))
+def nexthop_cols(
+    adj: jax.Array,
+    dist: jax.Array,
+    nxt: jax.Array,
+    cols: jax.Array,
+    max_degree: int,
+    valid: jax.Array | None = None,
+    safe: jax.Array | None = None,
+) -> jax.Array:
+    """Recompute ``next_hop[:, cols]`` against ``dist`` and scatter the
+    repaired columns into ``nxt`` (everything else untouched).
+
+    The column-restricted twin of :func:`apsp_next_hops`'s
+    degree-compact path — same neighbor table, same argmin, same
+    masking order — used by the incremental oracle to repair only the
+    destinations a link delta actually dirtied. ``cols`` is ``[C]``
+    int32 padded with ``>= V`` entries, which drop out at the scatter;
+    callers bucket C (kernels/tiling.col_bucket) so churn compiles a
+    bounded ladder of shapes instead of one per dirty-set size.
+    ``valid``/``safe`` optionally supply the [V, D] sorted-neighbor
+    table (the repair path derives it from the host order cache — same
+    construction as dag.neighbor_table — rather than re-sorting the
+    [V, V] adjacency on device per delta).
+    """
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("nexthop_cols")
+    v = adj.shape[0]
+    d = min(max_degree, v)
+    if valid is None or safe is None:
+        from sdnmpi_tpu.oracle.dag import neighbor_table
+
+        _, valid, safe = neighbor_table(adj, max_degree)
+    colsg = jnp.minimum(cols, v - 1)  # gather-safe; scatter drops pads
+    rows = jnp.arange(v, dtype=jnp.int32)[:, None]
+
+    def per_block(cols_b):  # [B] destination column indices
+        db = dist[:, cols_b]  # [V, B]
+        new = _degree_compact_block(valid, safe, db)
+        new = jnp.where(jnp.isinf(db), -1, new)
+        return jnp.where(rows == cols_b[None, :], rows, new)
+
+    c = cols.shape[0]
+    block = _fit_block(c, v * d)
+    if block == c:
+        new = per_block(colsg)
+    else:
+        blocks = lax.map(per_block, colsg.reshape(c // block, block))
+        new = jnp.moveaxis(blocks, 0, 1).reshape(v, c)
+    return nxt.at[:, cols].set(new, mode="drop")

@@ -18,6 +18,39 @@ import dataclasses
 import numpy as np
 
 
+def bucket_len(n: int, multiple: int = 8) -> int:
+    """Round a batch length up to the jit-cache bucket the oracle's
+    device entry points use (multiple-of-8, floor ``multiple``)."""
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def pad_flow_batch(
+    *arrays: np.ndarray, multiple: int = 8, fill: int = -1
+) -> tuple[np.ndarray, ...]:
+    """End-pad equal-length 1-D index arrays to a shared bucketed length.
+
+    Every device entry point pads its ``src``/``dst`` (and companion)
+    vectors through this before dispatch, so a stream of batches with
+    varying lengths compiles once per *bucket*, not once per length —
+    the jit cache stays bounded under arbitrary workloads. The fill
+    value ``-1`` is the path kernels' "dead flow" marker (masked out of
+    walks and reduces); end-padding keeps real rows' positions — and
+    therefore their hash streams — unchanged, so callers just trim
+    outputs back to the true length.
+    """
+    n = len(arrays[0])
+    padded = bucket_len(n, multiple)
+    if padded == n:
+        return arrays
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        p = np.full(padded, fill, dtype=a.dtype)
+        p[:n] = a
+        out.append(p)
+    return tuple(out)
+
+
 @dataclasses.dataclass
 class CollectiveRoutes:
     """Routes for an F-pair collective, S sub-flows, paths up to L hops.

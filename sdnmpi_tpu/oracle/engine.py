@@ -24,14 +24,21 @@ from sdnmpi_tpu.utils.tracing import STATS
 
 
 @jax.jit
-def _dist_span(dist, src, dst):
-    """(any reachable, max finite distance) over the selected pairs —
-    the device-side twin of ``_batch_max_len``'s host reduction, so a
-    batch dispatch never has to pull the [V, V] distance matrix to the
-    host just to size its hop budget (two scalars cross the link
-    instead of V^2 floats)."""
+def _dist_span(dist, src, dst, n):
+    """(any reachable, max finite distance) over the first ``n`` of the
+    selected pairs — the device-side twin of ``_batch_max_len``'s host
+    reduction, so a batch dispatch never has to pull the [V, V]
+    distance matrix to the host just to size its hop budget (two
+    scalars cross the link instead of V^2 floats). ``src``/``dst``
+    arrive bucket-padded (oracle/batch.pad_flow_batch) with the true
+    length as a traced scalar, so varying batch lengths share one
+    compiled trace per bucket instead of retracing per length."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("dist_span")
     sel = dist[src, dst]
-    finite = jnp.isfinite(sel)
+    valid = jnp.arange(sel.shape[0]) < n
+    finite = jnp.isfinite(sel) & valid
     return finite.any(), jnp.max(jnp.where(finite, sel, -jnp.inf))
 
 
@@ -242,11 +249,73 @@ class RouteOracle:
         #: topology version (every TopologyDB mutator bumps the version,
         #: so refresh() clearing it keeps the memo coherent)
         self._endpoint_memo: dict[str, Optional[tuple[int, int]]] = {}
+        #: observability for the incremental path: link deltas absorbed
+        #: by in-place repair vs full recompute passes (tests + bench
+        #: assert the churn path actually stays incremental)
+        self.repair_count: int = 0
+        self.full_refresh_count: int = 0
+
+    #: max link-level deltas the incremental repair path absorbs before
+    #: falling back to the full recompute (oracle/incremental.py); the
+    #: one-pivot repairs are applied sequentially, so past this count
+    #: the full kernels win. Mirrors Config.delta_repair_threshold for
+    #: direct constructors; 0 disables repair entirely.
+    from sdnmpi_tpu.config import DEFAULT_CONFIG as _DEFAULTS
+
+    delta_repair_threshold: int = _DEFAULTS.delta_repair_threshold
+    del _DEFAULTS
 
     # -- cache management -------------------------------------------------
 
+    def _try_repair(self, db: "TopologyDB") -> bool:
+        """Absorb the version gap by repairing the cached tensors in
+        place when the TopologyDB's delta log covers it with at most
+        ``delta_repair_threshold`` repairable deltas. Returns True when
+        the cache is current again without any full recompute."""
+        if (
+            self._tensors is None
+            or self._version is None
+            or not self.delta_repair_threshold
+            or self.max_diameter != 0  # capped BFS: repairs can't mirror it
+            or self.mesh_devices  # sharded refresh owns its own layout
+        ):
+            return False
+        # duck-typed TopologyDB stand-ins may predate the delta log
+        deltas_since = getattr(db, "deltas_since", None)
+        deltas = deltas_since(self._version) if deltas_since else None
+        if (
+            deltas is None
+            or not deltas
+            or len(deltas) != db.version - self._version
+        ):
+            return False
+        from sdnmpi_tpu.oracle import incremental
+
+        plan = incremental.plan_repair(self._tensors, db, deltas)
+        if plan is None:
+            return False
+        n_edges = len(plan.edges)
+        if n_edges > self.delta_repair_threshold:
+            return False
+        with STATS.timed("oracle_repair", version=db.version, n_edges=n_edges):
+            self._dist_d, self._next_d = incremental.apply_repairs(
+                self._tensors, self._dist_d, self._next_d, self._order,
+                plan.edges,
+            )
+            # repaired matrices invalidate the lazy host twins; the
+            # adjacency/port host twins were patched in place
+            self._dist_h = None
+            self._next_h = None
+            if plan.clear_memo:
+                self._endpoint_memo = {}
+            self._version = db.version
+            self.repair_count += n_edges
+        return True
+
     def refresh(self, db: "TopologyDB") -> TopoTensors:
         if self._version != db.version or self._tensors is None:
+            if self._try_repair(db):
+                return self._tensors
             with STATS.timed("oracle_refresh", version=db.version):
                 from sdnmpi_tpu import native
 
@@ -285,6 +354,7 @@ class RouteOracle:
                 self._order = native.neighbor_order(tensors.host_adj())
                 self._endpoint_memo = {}
                 self._version = db.version
+                self.full_refresh_count += 1
         return self._tensors
 
     @property
@@ -580,10 +650,16 @@ class RouteOracle:
         every padded hop expensive and distinct diameters are few.
         0 means nothing is reachable."""
         if self._dist_h is None and not self._twins_cheap():
+            from sdnmpi_tpu.oracle.batch import pad_flow_batch
+
+            src_p, dst_p = pad_flow_batch(
+                np.asarray(src_idx, np.int32), np.asarray(dst_idx, np.int32)
+            )
             any_f, mx = jax.device_get(_dist_span(
                 self._dist_d,
-                jnp.asarray(src_idx, jnp.int32),
-                jnp.asarray(dst_idx, jnp.int32),
+                jnp.asarray(src_p),
+                jnp.asarray(dst_p),
+                np.int32(len(src_idx)),
             ))
             if not bool(any_f):
                 return 0
@@ -627,11 +703,15 @@ class RouteOracle:
         if max_len == 0:
             return results
 
-        # small batches chase on host — but only when the host twins are
-        # already (or cheaply) materialized; on a large topology behind a
-        # remote link the one-off [V, V] download costs far more than a
-        # device dispatch, so those batches go through batch_fdb instead
-        host_chase = self._next_h is not None or self._twins_cheap()
+        # small batches chase on host — but only when BOTH host twins
+        # are already (or cheaply) materialized; the chase body reads
+        # _dist as well as _next, so gating on _next_h alone could
+        # silently download the [V, V] distance matrix on a large
+        # topology behind a remote link — exactly what the lazy twins
+        # exist to avoid. Those batches go through batch_fdb instead.
+        host_chase = (
+            self._next_h is not None and self._dist_h is not None
+        ) or self._twins_cheap()
         if host_chase and len(rows) * max_len <= self.host_chase_hop_budget:
             port_mat = self._port  # cached host copy: no device round-trip
             dpids = t.dpids
@@ -648,12 +728,15 @@ class RouteOracle:
                 results[k] = fdb
             return results
 
+        from sdnmpi_tpu.oracle.batch import pad_flow_batch
+
+        src_p, dst_p, fport_p = pad_flow_batch(src_idx, dst_idx, final_port)
         nodes, ports, length = batch_fdb(
             self._next_d,
             t.port,
-            jnp.asarray(src_idx),
-            jnp.asarray(dst_idx),
-            jnp.asarray(final_port),
+            jnp.asarray(src_p),
+            jnp.asarray(dst_p),
+            jnp.asarray(fport_p),
             max_len,
         )
         nodes = np.asarray(nodes)
@@ -740,17 +823,25 @@ class RouteOracle:
         # the jit shape so distinct collectives rarely retrace; on small
         # topologies where the 128 pad floor reaches V, restriction
         # would do MORE work than the full contraction, so skip it.
+        from sdnmpi_tpu.oracle.batch import pad_flow_batch
         from sdnmpi_tpu.oracle.dag import make_dst_nodes
 
         dn = make_dst_nodes(dst_idx)
+        # bucket the flow batch like every other oracle entry point:
+        # -1 pads are dead to the sampler and end-padding keeps real
+        # flows' ids (hash streams) unchanged, so distinct sub-flow
+        # counts share one compiled trace per bucket
+        src_p, dst_p = pad_flow_batch(
+            np.asarray(src_idx, np.int32), np.asarray(dst_idx, np.int32)
+        )
         buf = route_collective(
             t.adj,
             jnp.asarray(li),
             jnp.asarray(lj),
             jnp.asarray(util),
             jnp.asarray(traffic),
-            jnp.asarray(src_idx),
-            jnp.asarray(dst_idx),
+            jnp.asarray(src_p),
+            jnp.asarray(dst_p),
             levels=max_len - 1,
             rounds=rounds,
             max_len=max_len,
@@ -758,8 +849,8 @@ class RouteOracle:
             dist=self._dist_d,  # cached at this topology version: no BFS
             dst_nodes=jnp.asarray(dn) if len(dn) < t.v else None,
         )
-        slots, _ = unpack_result(np.asarray(buf), len(src_idx), max_len)
-        return self._decode(slots, src_idx, dst_idx)
+        slots, _ = unpack_result(np.asarray(buf), len(src_p), max_len)
+        return self._decode(slots[: len(src_idx)], src_idx, dst_idx)
 
     def _decode(self, slots, src_idx, dst_idx):
         """Shared slot decode of both DAG branches (C++ when built)."""
@@ -825,15 +916,23 @@ class RouteOracle:
                 order=self._order,
             )
         else:
+            from sdnmpi_tpu.oracle.batch import pad_flow_batch
+
             src_a = np.asarray(src_idx, np.int32)
             dst_a = np.asarray(dst_idx, np.int32)
+            # bucket-pad the batch (same -1 dead-flow contract as the
+            # mesh branch's shard padding) so varying batch lengths
+            # compile once per bucket, then trim below
+            src_a, dst_a = pad_flow_batch(src_a, dst_a)
+            w_a = np.zeros(len(src_a), np.float32)
+            w_a[:n] = np.asarray(weight, np.float32)
             # packed readback: pull the int8 slot streams (not the
             # decoded int32 node rows — ~10x the bytes) and decode
             # through the host twin; bit-identical (tests/test_dag.py)
             inter, s1, s2, _ = route_adaptive(
                 t.adj, jnp.asarray(base.astype(np.float32)),
                 jnp.asarray(src_a), jnp.asarray(dst_a),
-                jnp.asarray(np.asarray(weight, np.float32)),
+                jnp.asarray(w_a),
                 jnp.int32(t.n_real), packed=True, **kwargs,
             )
             inter = np.asarray(inter)
@@ -1165,13 +1264,18 @@ class RouteOracle:
             )
             paths = stitch_paths(n1, n2, inter_h)
         elif policy == "shortest":
+            from sdnmpi_tpu.oracle.batch import pad_flow_batch
+
+            ssrc_p, sdst_p = pad_flow_batch(
+                sub_src.astype(np.int32), sub_dst.astype(np.int32)
+            )
             nodes, _ = batch_paths(
                 self._next_d,
-                jnp.asarray(sub_src.astype(np.int32)),
-                jnp.asarray(sub_dst.astype(np.int32)),
+                jnp.asarray(ssrc_p),
+                jnp.asarray(sdst_p),
                 max_len,
             )
-            paths = np.asarray(nodes)
+            paths = np.asarray(nodes)[:n_sub]
         else:  # balanced — the flagship MXU fast path
             paths = self._dag_paths(
                 t,

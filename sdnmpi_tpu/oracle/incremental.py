@@ -1,0 +1,337 @@
+"""Incremental APSP repair: delta-aware distance/next-hop maintenance.
+
+Before this module, ANY topology mutation invalidated the oracle and
+the next query paid the full recovery pipeline — retensorize, BFS
+distances (diameter x [V, V] matmuls), next-hop recompute — even for a
+single link flap (oracle/engine.py refresh discipline; churn bench
+config 8 measures exactly this). DeltaPath-style incremental dataflow
+routing (arxiv 1808.06893) recomputes only the affected frontier after
+a delta; this module is that idea applied to the tensorized oracle:
+
+- **Link add (u, v)** — the classic one-pivot relaxation. A new edge
+  can only improve a pair by routing through it once, so
+
+      dist' = min(dist, dist[:, u] + w(u, v) + dist[v, :])
+
+  is exact in one ``O(V^2)`` broadcast (links here are unit-weight hop
+  counts, ``w = 1``). Next hops are then repaired only for the
+  destination columns the relaxation strictly improved, plus row ``u``
+  (whose neighbor set grew).
+- **Link remove (u, v)** — distances can grow, but only where the dead
+  edge was load-bearing. The *suspect destination columns* are exactly
+  ``{j : next_hop[u, j] == v}``: for any other column, every pair's
+  canonical next-hop walk provably avoids ``(u, v)`` (a walk can only
+  enter the edge at ``u``, and there it steps to ``next[u, j] != v``),
+  so a shortest path survives verbatim and the whole column's
+  distances — and hence its next hops, which are memoryless per-hop
+  argmins over the column — are unchanged. On ECMP-rich fabrics the
+  canonical tree concentrates on lowest-index neighbors, so most
+  removals leave a handful of suspect columns out of V. Those columns
+  are recomputed from scratch by a column-restricted reverse BFS —
+  the same boolean-matmul frontier expansion as ``apsp_distances``,
+  but over ``[V, C]`` one-hot columns instead of the full eye, an
+  ``O(diameter * V^2 * C)`` slice of the full ``O(diameter * V^3)``.
+  Next hops are then repaired for the columns whose distances actually
+  changed, plus row ``u``.
+- **Link rewire** (same edge, new source port) — pure port-matrix
+  update; distances and next hops are untouched.
+
+Every repaired tensor is bit-for-bit identical to a from-scratch
+recompute (asserted in tests/test_incremental.py): distances are unique
+integers, and the next-hop repair runs the same degree-compact
+argmin — shared code, oracle/apsp._degree_compact_block — as the full
+kernel, so the lowest-index tie-break cannot drift.
+
+Dirty-set sizes vary per delta, so every dynamic column set is padded
+to the bounded bucket ladder in kernels/tiling.col_bucket before it
+reaches a jitted kernel: churn compiles O(log V) shapes total, not one
+per flap. The delta source is the TopologyDB's epoch + dirty-set log
+(core/topology_db.deltas_since); RouteOracle falls back to the full
+kernels when the accumulated delta count crosses
+``Config.delta_repair_threshold`` or the log was broken by a
+structural mutation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sdnmpi_tpu.kernels.tiling import col_bucket
+from sdnmpi_tpu.oracle.apsp import INF, nexthop_cols
+from sdnmpi_tpu.utils.tracing import count_trace
+
+if TYPE_CHECKING:
+    from sdnmpi_tpu.core.topology_db import TopologyDB
+    from sdnmpi_tpu.oracle.engine import TopoTensors
+
+
+# -- jitted repair kernels -------------------------------------------------
+#
+# u/v/port arrive as traced scalars (0-d int32), never Python ints baked
+# into the trace: each kernel compiles once per (V, bucket, max_degree).
+
+
+@jax.jit
+def _set_link(adj, port, u, v, a_val, p_val):
+    """Point update of the dense adjacency/port matrices."""
+    return adj.at[u, v].set(a_val), port.at[u, v].set(p_val)
+
+
+@jax.jit
+def _relax_add(dist, u, v):
+    """One-pivot relaxation for a unit-weight edge add ``u -> v``.
+
+    Returns ``(dist', improved_cols)`` where ``improved_cols`` is the
+    [V] bool mask of destination columns any pair strictly improved in
+    — exactly the columns whose next hops need repair (ties keep their
+    old path: for rows != u neither the neighbor set nor any neighbor
+    distance changed, and the argmin is deterministic).
+    """
+    count_trace("incremental_relax_add")
+    cand = dist[:, u, None] + 1.0 + dist[v, :][None, :]
+    better = cand < dist
+    return jnp.where(better, cand, dist), better.any(axis=0)
+
+
+@jax.jit
+def _suspect_cols(nxt, u, v):
+    """[V] bool: destination columns whose canonical next-hop tree
+    rides edge ``u -> v`` — the only columns a removal can change.
+
+    A canonical walk can only traverse ``(u, v)`` by standing at ``u``
+    and stepping to ``next[u, j] == v``; every other column keeps, for
+    every source, a canonical shortest path that survives the removal
+    verbatim, pinning both its distances and (per-hop memoryless
+    argmin) its next hops."""
+    count_trace("incremental_suspect_cols")
+    return nxt[u, :] == v
+
+
+@jax.jit
+def _remove_repair(adj, dist, cols):
+    """Recompute the affected destination columns after edge removal.
+
+    ``adj`` is the post-removal adjacency; ``dist`` the pre-removal
+    distances; ``cols`` [C] int32 affected columns, padded with >= V
+    (pads recompute column V-1 redundantly and drop at the scatter —
+    the host masks their change flags). Returns ``(dist', changed)``
+    where ``changed`` [C] flags columns whose values actually differ.
+
+    The columns rebuild from scratch with the same boolean-matmul BFS
+    as ``apsp_distances``, run in reverse (``A @ F`` walks frontiers
+    backward from each destination) over [V, C] one-hot frontiers —
+    matmuls, not gathers, so the MXU/SIMD path that makes the full
+    APSP fast serves the repair too, at C/V of the cost.
+    """
+    count_trace("incremental_remove_repair")
+    v_dim = adj.shape[0]
+    a = (adj > 0).astype(jnp.float32)
+    colsg = jnp.minimum(cols, v_dim - 1)
+    f0 = (
+        jnp.arange(v_dim, dtype=jnp.int32)[:, None] == colsg[None, :]
+    ).astype(jnp.float32)
+    d0 = jnp.where(f0 > 0, 0.0, INF)
+
+    def cond(carry):
+        return carry[2]
+
+    def body(carry):
+        f, d, _, t = carry
+        grown = jnp.minimum(a @ f + f, 1.0)
+        newly = (grown > 0) & jnp.isinf(d)
+        d = jnp.where(newly, t.astype(jnp.float32), d)
+        return grown, d, jnp.any(newly), t + 1
+
+    _, new, _, _ = lax.while_loop(
+        cond, body, (f0, d0, jnp.bool_(True), jnp.int32(1))
+    )
+    changed = jnp.any(new != dist[:, colsg], axis=0)
+    return dist.at[:, cols].set(new, mode="drop"), changed
+
+
+@jax.jit
+def _nexthop_row(dist, nxt, row, valid, safe):
+    """Recompute ``next_hop[row, :]`` (the one row whose neighbor set a
+    link delta changes) through the caller's sorted-neighbor table.
+    Same argmin and masking order as apsp_next_hops, restricted to one
+    row — a [D, V] gather."""
+    count_trace("incremental_nexthop_row")
+    v_dim = dist.shape[0]
+    nu = safe[row]  # [D] sorted neighbors of the row
+    cand = jnp.where(valid[row][:, None], dist[nu, :], INF)  # [D, V]
+    new = nu[jnp.argmin(cand, axis=0)]  # first-hit == lowest neighbor
+    new = jnp.where(jnp.isinf(dist[row, :]), -1, new)
+    idx = jnp.arange(v_dim, dtype=jnp.int32)
+    new = jnp.where(idx == row, row, new)
+    return nxt.at[row, :].set(new)
+
+
+# -- delta planning / validation ------------------------------------------
+
+
+@dataclasses.dataclass
+class RepairPlan:
+    """Validated, tensor-index-resolved form of a delta-log slice."""
+
+    #: ("add" | "remove" | "rewire", row index u, col index v, port)
+    edges: list[tuple[str, int, int, int]]
+    #: a switch/host membership delta occurred: endpoint memo must clear
+    clear_memo: bool = False
+
+
+def plan_repair(
+    tensors: "TopoTensors", db: "TopologyDB", deltas: list[tuple]
+) -> Optional[RepairPlan]:
+    """Resolve a delta-log slice against the cached tensors, or None
+    when any delta falls outside what in-place repair can express:
+    an endpoint the tensors never indexed (node set would change), or
+    an add that would push a row past the compact neighbor table's
+    static ``max_degree`` capacity.
+
+    No-op deltas (removing an absent edge, re-adding an identical one)
+    validate to nothing; the whole plan may legitimately be empty.
+    """
+    index = tensors.index
+    adj = tensors.host_adj()
+    cap = min(tensors.max_degree, tensors.v)
+    deg = (adj > 0).sum(axis=1).astype(np.int64)
+    edge_state: dict[tuple[int, int], bool] = {}
+    edges: list[tuple[str, int, int, int]] = []
+    clear_memo = False
+
+    for entry in deltas:
+        kind = entry[1]
+        if kind == "switch_upsert":
+            continue  # port-set refresh of a known switch: graph untouched
+        if kind in ("switch_new", "host"):
+            if entry[2] not in index:
+                return None  # node set grew/shrank: needs retensorize
+            clear_memo = True  # switches/hosts dicts changed membership
+            continue
+        if kind == "link+":
+            _, _, a, b, port_no = entry
+            ia, ib = index.get(a), index.get(b)
+            if ia is None or ib is None:
+                return None
+            present = edge_state.get((ia, ib), adj[ia, ib] > 0)
+            if present:
+                edges.append(("rewire", ia, ib, port_no))
+            else:
+                if deg[ia] + 1 > cap:
+                    return None  # would overflow the neighbor table
+                deg[ia] += 1
+                edge_state[(ia, ib)] = True
+                edges.append(("add", ia, ib, port_no))
+        elif kind == "link-":
+            _, _, a, b = entry
+            ia, ib = index.get(a), index.get(b)
+            if ia is None or ib is None:
+                return None
+            if not edge_state.get((ia, ib), adj[ia, ib] > 0):
+                continue  # removing an absent edge: no-op
+            deg[ia] -= 1
+            edge_state[(ia, ib)] = False
+            edges.append(("remove", ia, ib, -1))
+        else:  # unknown delta kind from a future log version
+            return None
+    return RepairPlan(edges, clear_memo)
+
+
+# -- application -----------------------------------------------------------
+
+
+def _pad_cols(cols: np.ndarray, v: int) -> np.ndarray:
+    """Bucket-pad a dirty-column index vector with V (dropped at the
+    scatters, clipped at the gathers)."""
+    out = np.full(col_bucket(len(cols), v), v, dtype=np.int32)
+    out[: len(cols)] = cols
+    return out
+
+
+def apply_repairs(
+    tensors: "TopoTensors",
+    dist,
+    nxt,
+    order: Optional[np.ndarray],
+    edges: list[tuple[str, int, int, int]],
+):
+    """Apply a validated plan's edge repairs in order.
+
+    Mutates the tensors' device adjacency/port matrices and their host
+    twins (plus the cached sorted-neighbor ``order`` row) in place and
+    returns the repaired ``(dist, next_hop)`` device arrays.
+
+    The degree-compact [V, D] neighbor table the next-hop repairs argmin
+    through is sliced from the host ``order`` cache (same construction
+    as dag.neighbor_table, maintained row-wise below) — a small H2D
+    upload per delta instead of a [V, V] device sort per kernel.
+    """
+    v = tensors.v
+    adj_h = tensors.host_adj()
+    port_h = tensors.host_port()
+    d = min(tensors.max_degree, v)
+    if order is None:
+        from sdnmpi_tpu import native
+
+        order = native.neighbor_order(adj_h)
+
+    for kind, ia, ib, port_no in edges:
+        u = np.int32(ia)
+        w = np.int32(ib)
+        if kind == "rewire":
+            port_h[ia, ib] = port_no
+            tensors.adj, tensors.port = _set_link(
+                tensors.adj, tensors.port, u, w,
+                jnp.float32(1.0), np.int32(port_no),
+            )
+            continue
+        if kind == "add":
+            adj_h[ia, ib] = 1.0
+            port_h[ia, ib] = port_no
+            tensors.adj, tensors.port = _set_link(
+                tensors.adj, tensors.port, u, w,
+                jnp.float32(1.0), np.int32(port_no),
+            )
+            dist, improved = _relax_add(dist, u, w)
+            dirty = np.flatnonzero(np.asarray(improved))
+        else:  # remove
+            adj_h[ia, ib] = 0.0
+            port_h[ia, ib] = -1
+            tensors.adj, tensors.port = _set_link(
+                tensors.adj, tensors.port, u, w,
+                jnp.float32(0.0), np.int32(-1),
+            )
+            suspect = np.flatnonzero(np.asarray(_suspect_cols(nxt, u, w)))
+            if len(suspect):
+                dist, changed = _remove_repair(
+                    tensors.adj, dist, _pad_cols(suspect, v)
+                )
+                flags = np.asarray(changed)[: len(suspect)]
+                dirty = suspect[flags]
+            else:
+                dirty = suspect  # empty
+        # refresh the mutated row of the sorted-neighbor cache, then
+        # slice the device table from it
+        row = np.where(
+            adj_h[ia] > 0, np.arange(v, dtype=np.int32), v
+        ).astype(np.int32)
+        row.sort()
+        order[ia] = row
+        tbl = order[:, :d]
+        valid = jnp.asarray(tbl < v)
+        safe = jnp.asarray(np.minimum(tbl, v - 1))
+        if len(dirty):
+            nxt = nexthop_cols(
+                tensors.adj, dist, nxt, _pad_cols(dirty, v),
+                tensors.max_degree, valid, safe,
+            )
+        # the delta's own row always repairs: its neighbor set changed
+        nxt = _nexthop_row(dist, nxt, u, valid, safe)
+    return dist, nxt
+

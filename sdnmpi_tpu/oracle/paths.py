@@ -31,6 +31,9 @@ def batch_paths(
     Callers with access to the distance matrix must size it from the
     batch's true maximum (see RouteOracle.routes_batch).
     """
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("batch_paths")
 
     def step(node, _):
         # node: [F] current switch (or -1 once finished/unreachable)
@@ -70,6 +73,9 @@ def batch_fdb(
     valid hop's port is ``final_port[f]`` (edge switch -> host), matching
     the reference's fdb layout (topology_db.py:127-138).
     """
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("batch_fdb")
     nodes, length = batch_paths(next_hop, src, dst, max_len)
     f = nodes.shape[0]
     safe = jnp.maximum(nodes, 0)

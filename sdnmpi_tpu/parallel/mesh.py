@@ -27,7 +27,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x: experimental home, check_vma spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(*args, **kwargs)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sdnmpi_tpu.oracle.apsp import INF

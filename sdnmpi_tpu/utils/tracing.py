@@ -92,6 +92,20 @@ class OracleStats:
 #: process-wide stats instance the oracle layers record into
 STATS = OracleStats()
 
+#: per-kernel trace (compile) counters: jitted oracle kernels call
+#: ``count_trace`` at the top of their Python bodies, which only run
+#: when XLA actually traces — so the counter measures jit-cache misses,
+#: not dispatches. Tests use it to assert the batch-length bucketing
+#: keeps the cache bounded (one trace per bucket, not per length).
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def count_trace(kernel: str) -> None:
+    """Record one jit trace of ``kernel`` (no-op on cached dispatches,
+    because the traced Python body never re-runs)."""
+    TRACE_COUNTS[kernel] += 1
+    trace_event("jit_trace", kernel=kernel)
+
 
 @contextlib.contextmanager
 def device_trace(profile_dir: Optional[str]):

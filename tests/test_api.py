@@ -225,6 +225,12 @@ class TestWebSocketTransport:
             for _ in range(100):
                 if server_task.done():
                     server_task.result()  # surface the real bind error
+                    # no exception: the server returned before ever
+                    # listening — retrying can never succeed, so fail
+                    # now instead of spinning out the full timeout
+                    raise AssertionError(
+                        "RPC server exited before listening"
+                    )
                 try:
                     ws = await websockets.connect(uri)
                     break

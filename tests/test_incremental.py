@@ -1,0 +1,244 @@
+"""Incremental path oracle: delta-aware APSP repair (oracle/incremental.py).
+
+The contract under test: after any repairable sequence of link
+add/remove/rewire deltas, the repaired distance/next-hop/adjacency/port
+tensors (and the host-side neighbor-order cache) are BIT-FOR-BIT equal
+to a from-scratch recompute of the same TopologyDB state — and the
+repair path actually ran (no silent full-refresh fallbacks). Fallback
+paths (delta threshold, structural breaks, log overflow) are asserted
+to fall back, and the batch-length bucketing is asserted to bound the
+jit cache via the trace-count probe.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.core.topology_db import Link, Port, Switch, Host
+from sdnmpi_tpu.oracle.engine import RouteOracle
+from sdnmpi_tpu.topogen import fattree, linear, torus2d
+
+
+def _fresh(db):
+    """Full-recompute oracle of the db's current state."""
+    full = RouteOracle(db.pad_multiple, db.max_diameter)
+    full.delta_repair_threshold = 0
+    full.refresh(db)
+    return full
+
+
+def _assert_matches_full(oracle, db):
+    full = _fresh(db)
+    np.testing.assert_array_equal(
+        np.asarray(oracle._dist_d), np.asarray(full._dist_d)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(oracle._next_d), np.asarray(full._next_d)
+    )
+    t, tf = oracle._tensors, full._tensors
+    np.testing.assert_array_equal(np.asarray(t.adj), np.asarray(tf.adj))
+    np.testing.assert_array_equal(np.asarray(t.port), np.asarray(tf.port))
+    np.testing.assert_array_equal(t.host_adj(), tf.host_adj())
+    np.testing.assert_array_equal(t.host_port(), tf.host_port())
+    np.testing.assert_array_equal(oracle._order, full._order)
+
+
+def _cables(db):
+    return [
+        (db.links[a][b], db.links[b][a])
+        for a in sorted(db.links)
+        for b in sorted(db.links[a])
+        if a < b
+    ]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "spec_fn",
+    [
+        lambda: linear(8),
+        lambda: fattree(4),
+        lambda: torus2d(3, 3),
+    ],
+    ids=["linear8", "fattree4", "torus3x3"],
+)
+def test_random_delta_sequence_matches_full_recompute(spec_fn, seed):
+    """Randomized add/remove/rewire storms on linear, fat-tree, and
+    torus fabrics: every repaired tensor must match a from-scratch
+    recompute exactly, with the repair path doing all the work after
+    the first refresh. Linear cable cuts partition the graph, so the
+    inf/unreachable handling is exercised too."""
+    db = spec_fn().to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    rng = np.random.default_rng(seed)
+    down: list = []  # cables currently removed
+    for step in range(16):
+        op = rng.integers(3)
+        if op == 0 or (op == 1 and not down):  # delete a live cable
+            cable = _cables(db)[rng.integers(len(_cables(db)))]
+            for lk in cable:
+                db.delete_link(lk)
+            down.append(cable)
+        elif op == 1:  # restore a dead cable
+            for lk in down.pop(rng.integers(len(down))):
+                db.add_link(lk)
+        else:  # "reweight": re-add a live directed link on a new port
+            cables = _cables(db)
+            lk = cables[rng.integers(len(cables))][0]
+            db.add_link(
+                Link(
+                    Port(lk.src.dpid, lk.src.port_no + 10),
+                    Port(lk.dst.dpid, lk.dst.port_no),
+                )
+            )
+        oracle.refresh(db)
+        _assert_matches_full(oracle, db)
+    assert oracle.full_refresh_count == 1, "storm must stay incremental"
+    assert oracle.repair_count > 0
+
+
+def test_routes_stay_correct_through_repairs():
+    """End-to-end: find_route answers against repaired tensors must
+    match the pure-Python differential oracle after each delta."""
+    db = fattree(4).to_topology_db(backend="jax")
+    py = fattree(4).to_topology_db(backend="py")
+    macs = sorted(db.hosts)
+    pairs = [(macs[0], macs[-1]), (macs[1], macs[2])]
+    rng = np.random.default_rng(3)
+    removed = None
+    for _ in range(8):
+        if removed is None:
+            cables = _cables(db)
+            removed = cables[rng.integers(len(cables))]
+            ops = [("del", lk) for lk in removed]
+        else:
+            ops = [("add", lk) for lk in removed]
+            removed = None
+        for kind, lk in ops:
+            (db.delete_link if kind == "del" else db.add_link)(lk)
+            (py.delete_link if kind == "del" else py.add_link)(lk)
+        for s, d in pairs:
+            assert db.find_route(s, d) == py.find_route(s, d)
+    assert db._jax_oracle().full_refresh_count == 1
+
+
+def test_delta_threshold_falls_back_to_full():
+    db = fattree(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.delta_repair_threshold = 2
+    oracle.refresh(db)
+    # three cables = six link deltas > threshold
+    for cable in _cables(db)[:3]:
+        for lk in cable:
+            db.delete_link(lk)
+    oracle.refresh(db)
+    assert oracle.repair_count == 0
+    assert oracle.full_refresh_count == 2
+    _assert_matches_full(oracle, db)
+
+
+def test_structural_mutation_breaks_delta_log():
+    db = linear(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    v0 = db.version
+    sw = db.switches[1]
+    db.delete_switch(sw)
+    assert db.deltas_since(v0) is None
+    db.add_switch(sw)  # new node for the log, known dpid for the oracle
+    oracle.refresh(db)
+    assert oracle.full_refresh_count == 2
+    _assert_matches_full(oracle, db)
+
+
+def test_unknown_endpoint_falls_back_to_full():
+    """A link delta whose endpoint the tensors never indexed (node set
+    grows) cannot be repaired in place."""
+    db = linear(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    db.add_switch(Switch.make(99))
+    db.add_link(Link(Port(99, 2), Port(1, 9)))
+    db.add_link(Link(Port(1, 9), Port(99, 2)))
+    oracle.refresh(db)
+    assert oracle.full_refresh_count == 2
+    _assert_matches_full(oracle, db)
+
+
+def test_host_delta_repairs_in_place_and_clears_memo():
+    """Adding/moving a host on an already-indexed switch is a memo-only
+    delta: no recompute, and stale endpoint resolutions cannot leak."""
+    db = linear(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    macs = sorted(db.hosts)
+    assert db.find_route(macs[0], macs[1])  # warms the endpoint memo
+    new_mac = "02:00:00:00:00:aa"
+    db.add_host(Host(new_mac, Port(3, 7)))
+    route = db.find_route(macs[0], new_mac)
+    assert route and route[-1] == (3, 7)
+    assert oracle.full_refresh_count == 1
+    # move the host to another switch: same delta kind, memo re-cleared
+    db.add_host(Host(new_mac, Port(2, 7)))
+    route = db.find_route(macs[0], new_mac)
+    assert route and route[-1] == (2, 7)
+    assert oracle.full_refresh_count == 1
+
+
+def test_delta_log_overflow_forces_full_refresh():
+    db = linear(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    cable = _cables(db)[0]
+    for _ in range(40):  # 160 deltas >> log cap
+        for lk in cable:
+            db.delete_link(lk)
+        for lk in cable:
+            db.add_link(lk)
+    assert db.deltas_since(oracle._version) is None
+    oracle.refresh(db)
+    assert oracle.full_refresh_count == 2
+    _assert_matches_full(oracle, db)
+
+
+def test_repair_preserves_downstream_query_paths():
+    """Batched/balanced queries run against repaired tensors and agree
+    with a fresh oracle's answers (adj/port/order coherence)."""
+    db = fattree(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    macs = sorted(db.hosts)
+    pairs = [(a, b) for a in macs[:4] for b in macs[4:8] if a != b]
+    before = db.find_routes_batch(pairs)
+    cable = _cables(db)[2]
+    for lk in cable:
+        db.delete_link(lk)
+    repaired = db.find_routes_batch(pairs)
+    fresh_db = fattree(4).to_topology_db(backend="jax")
+    for lk in cable:
+        fresh_db.delete_link(lk)
+    assert repaired == fresh_db.find_routes_batch(pairs)
+    assert oracle.full_refresh_count == 1
+    for lk in cable:
+        db.add_link(lk)
+    assert db.find_routes_batch(pairs) == before
+
+
+def test_varying_batch_lengths_compile_once_per_bucket():
+    """The jit-cache bound: a stream of oracle calls with lengths 2..13
+    must trace each device kernel at most once per bucket (8 and 16),
+    not once per length."""
+    from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+    db = fattree(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle._twins_cheap = lambda: False  # force the padded device paths
+    macs = sorted(db.hosts)
+    TRACE_COUNTS.clear()
+    for n in range(2, 14):
+        pairs = [
+            (macs[i % len(macs)], macs[(i + 3) % len(macs)])
+            for i in range(n)
+        ]
+        db.find_routes_batch(pairs)
+    assert TRACE_COUNTS["dist_span"] <= 2
+    assert TRACE_COUNTS["batch_fdb"] <= 2
+    assert TRACE_COUNTS["batch_paths"] <= 2
