@@ -36,6 +36,11 @@ Prints exactly one JSON line on stdout; details go to stderr.
 link-flap storm during a route stream, plus the incremental-repair vs
 full-recompute comparison) and prints its BENCH-format JSON lines — the
 same rows the suite driver collects as config 8.
+
+``python bench.py utilplane`` runs the utilization-plane scenario
+(config 9: steady-state sample-ingest latency and balanced routing
+with the device-resident utilization tensor vs the per-call host
+rebuild) and prints its BENCH-format JSON lines.
 """
 
 from __future__ import annotations
@@ -224,5 +229,9 @@ if __name__ == "__main__":
         from benchmarks.config8_churn import main as churn_main
 
         churn_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "utilplane":
+        from benchmarks.config9_utilplane import main as utilplane_main
+
+        utilplane_main()
     else:
         main()

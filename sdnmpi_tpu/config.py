@@ -62,6 +62,21 @@ class Config:
     max_enumerated_paths: int = 1024
     #: weight of link utilization when scoring congestion-aware routes
     congestion_alpha: float = 1.0
+    #: keep the measured-utilization state device-resident
+    #: (oracle/utilplane.py): Monitor samples scatter into a persistent
+    #: [V, V] tensor maintained through the topology delta log, and the
+    #: balanced/adaptive/collective base cost becomes a pure device
+    #: expression — no per-call host rebuild or [V, V] upload. Only
+    #: meaningful with the jax backend; False falls back to the host
+    #: dict rebuild (the differential-testing path).
+    util_plane: bool = True
+    #: EWMA weight of each fresh Monitor sample folded into the
+    #: device-resident utilization plane: ``u' = (1-a)*u + a*sample``.
+    #: 1.0 (default) is pure replacement — bit-identical to the host
+    #: rebuild from the raw sample dict; lower values smooth bursty
+    #: counters at the cost of reaction latency. Applied per flushed
+    #: sample batch (the Monitor's own delta cadence), not per second.
+    util_ewma_alpha: float = 1.0
     #: nominal link capacity used to normalize the Monitor's bps samples
     #: into flow-equivalent units before they enter the balancer's score
     link_capacity_bps: float = 10e9

@@ -333,3 +333,11 @@ class EventPortStats(Event):
     rx_bps: float
     tx_pps: float
     tx_bps: float
+
+
+@dataclasses.dataclass
+class EventStatsFlush(Event):
+    """End of one Monitor sampling pass: every EventPortStats of the
+    pass has been published. Utilization consumers use this edge to
+    flush their staged samples as ONE vectorized batch (the device
+    utilization plane scatters once per pass, not once per port)."""

@@ -69,9 +69,13 @@ class Monitor:
     # -- sampling ---------------------------------------------------------
 
     def poll(self, now: Optional[float] = None) -> None:
-        """One sampling pass over every live datapath."""
+        """One sampling pass over every live datapath. The end of the
+        pass publishes EventStatsFlush so utilization consumers ingest
+        the pass's samples as one vectorized batch (the device
+        utilization plane's scatter cadence)."""
         for dpid in sorted(self.datapaths):
             self._poll_one(dpid, time.time() if now is None else now)
+        self.bus.publish(ev.EventStatsFlush())
 
     def _poll_one(self, dpid: int, now: float) -> None:
         """Sample one datapath — the unit shared by the synchronous
@@ -141,6 +145,8 @@ class Monitor:
                 self._poll_one(dpid, time.time())
                 if (i + 1) % self.POLL_SLICE == 0:
                     await asyncio.sleep(0)
+            # one vectorized utilization flush per pass (see poll())
+            self.bus.publish(ev.EventStatsFlush())
             elapsed = loop.time() - started
             await asyncio.sleep(
                 max(0.0, self.config.monitor_interval - elapsed)

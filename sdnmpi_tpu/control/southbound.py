@@ -13,10 +13,12 @@ byte codec (protocol/ofwire.py):
   PacketIn, FlowRemoved, and port StatsReply;
 - the same app-facing surface as the simulated ``Fabric``
   (``flow_mod`` / ``packet_out`` / ``port_stats`` /
-  ``flow_block_set`` / ``connected_dpids``) and the same bus events
-  (EventDatapathUp/Down, EventSwitchEnter/Leave, EventPacketIn,
-  EventFlowRemoved) — so the entire controller runs unchanged against
-  real switches; the Fabric remains the hermetic test double.
+  ``flow_block_set`` / ``connected_dpids`` / the ``on_idle``
+  burst-drained hook the route coalescer flushes from) and the same
+  bus events (EventDatapathUp/Down, EventSwitchEnter/Leave,
+  EventPacketIn, EventFlowRemoved) — so the entire controller,
+  including ``Config.coalesce_routes``, runs unchanged against real
+  switches; the Fabric remains the hermetic test double.
 
 Asynchrony note: ``port_stats`` is a synchronous pull in the app API
 (the Monitor differentiates counters at its own cadence). Over TCP it
@@ -69,6 +71,16 @@ class OFSouthbound:
         self._stats: dict[int, list[of.PortStatsEntry]] = {}
         self._cookie_flows: dict[int, list] = {}
         self._xid = 0
+        #: called after a connection's read burst fully drains — every
+        #: complete frame of one TCP read has been dispatched and no
+        #: partial frame remains unhandled in this slice. The same idle
+        #: edge the simulated Fabric provides (control/fabric.py), so
+        #: the Router's route coalescer works on real switches too: a
+        #: burst of packet-ins from one socket read resolves as one
+        #: padded batched oracle call when the burst ends, and a lone
+        #: parked packet never waits for a companion that isn't coming.
+        #: None = no coalescing (Controller arms it).
+        self.on_idle = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -116,31 +128,8 @@ class OFSouthbound:
                 if not data:
                     break
                 buf += data
-                while len(buf) >= 8:
-                    # version-tolerant framing: a peer's HELLO advertises
-                    # its HIGHEST version (OVS default: 1.3+) and the
-                    # sides settle on the minimum — 1.0 here. Only a
-                    # non-HELLO at a version we never negotiated is a
-                    # protocol error.
-                    version, msg_type, length, xid = struct.unpack_from(
-                        "!BBHI", buf
-                    )
-                    if version != ofwire.OFP_VERSION and (
-                        msg_type != ofwire.OFPT_HELLO
-                    ):
-                        raise ValueError(
-                            f"message type {msg_type} at unnegotiated "
-                            f"version 0x{version:02x}"
-                        )
-                    if length < 8:
-                        # OF header is 8 bytes; a shorter declared length
-                        # would consume nothing and spin this loop forever
-                        raise ValueError(f"bad header length {length}")
-                    if len(buf) < length:
-                        break
-                    msg, buf = buf[:length], buf[length:]
-                    dpid = self._dispatch(msg_type, msg, xid, dpid, writer)
-                    await writer.drain()
+                dpid, buf = self._drain_frames(buf, dpid, writer)
+                await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         except (ValueError, struct.error) as e:
@@ -158,6 +147,53 @@ class OFSouthbound:
                     )
                 log.info("datapath %#x disconnected", dpid)
             writer.close()
+
+    def _drain_frames(self, buf: bytes, dpid: int | None,
+                      writer: asyncio.StreamWriter):
+        """Dispatch every complete frame in ``buf``; returns the
+        (possibly learned) dpid and the remaining partial buffer.
+        Replies are drained once per burst by the caller.
+
+        The idle notification fires from a ``finally`` so a burst that
+        dispatched SOME frames before a later frame raised (protocol
+        error, dying socket) still flushes coalesced work — a parked
+        route lookup has no timer here to rescue it otherwise."""
+        progressed = False
+        try:
+            while len(buf) >= 8:
+                # version-tolerant framing: a peer's HELLO advertises
+                # its HIGHEST version (OVS default: 1.3+) and the
+                # sides settle on the minimum — 1.0 here. Only a
+                # non-HELLO at a version we never negotiated is a
+                # protocol error.
+                version, msg_type, length, xid = struct.unpack_from(
+                    "!BBHI", buf
+                )
+                if version != ofwire.OFP_VERSION and (
+                    msg_type != ofwire.OFPT_HELLO
+                ):
+                    raise ValueError(
+                        f"message type {msg_type} at unnegotiated "
+                        f"version 0x{version:02x}"
+                    )
+                if length < 8:
+                    # OF header is 8 bytes; a shorter declared length
+                    # would consume nothing and spin this loop forever
+                    raise ValueError(f"bad header length {length}")
+                if len(buf) < length:
+                    break
+                msg, buf = buf[:length], buf[length:]
+                dpid = self._dispatch(msg_type, msg, xid, dpid, writer)
+                progressed = True
+        finally:
+            if progressed:
+                # burst drained: flush coalesced work (see on_idle)
+                self._notify_idle()
+        return dpid, buf
+
+    def _notify_idle(self) -> None:
+        if self.on_idle is not None:
+            self.on_idle()
 
     def _dispatch(self, msg_type: int, msg: bytes, xid: int,
                   dpid: int | None, writer: asyncio.StreamWriter) -> int | None:

@@ -58,6 +58,18 @@ class TopologyManager:
         self.link_util: dict[tuple[int, int], float] = {}
         self._tx_util: dict[tuple[int, int], float] = {}
         self._rx_util: dict[tuple[int, int], float] = {}
+        #: device-resident twin of link_util (oracle/utilplane.py):
+        #: samples stage here too and flush to a persistent on-device
+        #: [V, V] tensor once per Monitor pass, so the oracle's base
+        #: cost needs no per-call host rebuild. The host dict stays
+        #: authoritative for snapshots/RPC and as the differential
+        #: oracle; None on the pure-Python backend (which has no
+        #: balancing to feed) or when Config.util_plane is off.
+        self.util_plane = None
+        if config.oracle_backend == "jax" and config.util_plane:
+            from sdnmpi_tpu.oracle.utilplane import UtilPlane
+
+            self.util_plane = UtilPlane(config.util_ewma_alpha)
         #: (dst_dpid, dst_port) -> (src_dpid, src_port) of the directed
         #: link arriving there, for attributing rx samples
         self._link_rev: dict[tuple[int, int], tuple[int, int]] = {}
@@ -72,6 +84,7 @@ class TopologyManager:
         bus.subscribe(ev.EventHostAdd, lambda e: self.topologydb.add_host(e.host))
         bus.subscribe(ev.EventPacketIn, self._packet_in)
         bus.subscribe(ev.EventPortStats, self._port_stats)
+        bus.subscribe(ev.EventStatsFlush, self._stats_flush)
 
         bus.provide(ev.CurrentTopologyRequest, self._current_topology)
         bus.provide(ev.FindRouteRequest, self._find_route)
@@ -134,7 +147,7 @@ class TopologyManager:
         if req.policy == "balanced":
             fdbs, max_congestion = self.topologydb.find_routes_batch_balanced(
                 req.pairs,
-                link_util=self.link_util,
+                link_util=self.routing_util(),
                 alpha=self.config.congestion_alpha,
                 chunk=self.config.ecmp_chunk,
                 link_capacity=self.config.link_capacity_bps,
@@ -147,7 +160,7 @@ class TopologyManager:
             fdbs, n_detours, max_congestion = (
                 self.topologydb.find_routes_batch_adaptive(
                     req.pairs,
-                    link_util=self.link_util,
+                    link_util=self.routing_util(),
                     ugal_candidates=self.config.ugal_candidates,
                     ugal_bias=self.config.ugal_bias,
                     alpha=self.config.congestion_alpha,
@@ -170,7 +183,7 @@ class TopologyManager:
     ) -> ev.FindCollectiveRoutesReply:
         cfg = self.config
         kwargs = dict(
-            link_util=self.link_util,
+            link_util=self.routing_util(),
             alpha=cfg.congestion_alpha,
             link_capacity=cfg.link_capacity_bps,
             ecmp_ways=cfg.ecmp_ways,
@@ -286,8 +299,36 @@ class TopologyManager:
         self.link_util.pop(key, None)
         self._tx_util.pop(key, None)
         self._rx_util.pop(key, None)
+        if self.util_plane is not None:
+            # staged-but-unflushed samples die with the link; the
+            # device slot itself is zeroed through the delta-log repair
+            # seam on the plane's next sync
+            self.util_plane.drop(key)
 
     # -- utilization ingest -----------------------------------------------
+
+    def routing_util(self):
+        """The utilization input the oracle receives: the device plane
+        when enabled, the raw host dict otherwise."""
+        return self.util_plane if self.util_plane is not None else self.link_util
+
+    def restore_link_util(self, samples: dict[tuple[int, int], float]) -> None:
+        """Checkpoint restore: seed the host dict AND stage the device
+        plane, so a resumed controller routes on warm utilization
+        without waiting a Monitor interval."""
+        self.link_util.update(samples)
+        if self.util_plane is not None:
+            for key, bps in samples.items():
+                self.util_plane.stage(key, bps)
+
+    def _stats_flush(self, event: ev.EventStatsFlush) -> None:
+        """Monitor end-of-pass edge: one vectorized scatter of the
+        pass's staged samples into the device plane. Before the plane
+        is bound (no routing call has built tensors yet) samples simply
+        stay staged — the first base-cost evaluation flushes them."""
+        p = self.util_plane
+        if p is not None and p.sync(self.topologydb):
+            p.flush()
 
     def _port_stats(self, event: ev.EventPortStats) -> None:
         key = (event.dpid, event.port_no)
@@ -302,6 +343,9 @@ class TopologyManager:
             self._refresh_util(src)
 
     def _refresh_util(self, key: tuple[int, int]) -> None:
-        self.link_util[key] = max(
+        value = max(
             self._tx_util.get(key, 0.0), self._rx_util.get(key, 0.0)
         )
+        self.link_util[key] = value
+        if self.util_plane is not None:
+            self.util_plane.stage(key, value)

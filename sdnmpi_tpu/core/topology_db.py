@@ -329,6 +329,11 @@ class TopologyDB:
         (oracle/dag.py); smaller ones the exact greedy scanner
         (oracle/congestion.py) — see RouteOracle.routes_batch_balanced.
 
+        ``link_util`` accepts either the raw ``(dpid, port) -> bps``
+        host dict or a device-resident
+        :class:`~sdnmpi_tpu.oracle.utilplane.UtilPlane` (zero per-call
+        host rebuild — the steady-state production input).
+
         The pure-Python backend has no balancing; it degrades to the plain
         batch with a congestion figure computed from the chosen paths.
         """

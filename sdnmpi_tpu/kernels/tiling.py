@@ -32,3 +32,26 @@ def col_bucket(n: int, v: int) -> int:
     while b < n:
         b *= 2
     return min(b, v)
+
+
+def bucket_pad(idx, sentinel: int, cap: int, vals=None):
+    """Bucket-pad an int32 index vector with a drop ``sentinel``
+    (``col_bucket`` ladder capped at ``cap``), optionally zero-padding
+    a parallel f32 value vector to the same length.
+
+    The single padding contract shared by the dirty-column repair
+    scatters (oracle/incremental.py) and the utilization plane's sample
+    scatters (oracle/utilplane.py): pads carry an out-of-range index
+    that drops at the scatter and clamps at the gather, so both kernel
+    families compile the same bounded shape ladder.
+    """
+    import numpy as np
+
+    n = col_bucket(len(idx), cap)
+    out = np.full(n, sentinel, dtype=np.int32)
+    out[: len(idx)] = idx
+    if vals is None:
+        return out, None
+    v = np.zeros(n, dtype=np.float32)
+    v[: len(vals)] = vals
+    return out, v

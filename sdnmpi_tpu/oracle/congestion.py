@@ -190,18 +190,43 @@ def utilization_matrix(
     tensors, link_util: dict[tuple[int, int], float]
 ) -> np.ndarray:
     """Map the Monitor's (dpid, port_no) -> bps samples onto the [V, V]
-    directed-link cost matrix using the topology's port map."""
+    directed-link cost matrix using the topology's port map.
+
+    Fully vectorized: samples and link endpoints meet in a sorted
+    ``searchsorted`` join over ``row * K + port_no`` keys instead of a
+    Python loop over every port of every switch — this is the host
+    fallback AND the differential oracle the device-resident
+    utilization plane (oracle/utilplane.py) is tested bit-identical
+    against, so it has to stay cheap enough to run in every
+    equivalence check. Zero/absent samples leave 0 entries, unmapped
+    samples (unknown dpid, or a port no link rides) are ignored —
+    the same semantics the per-entry loop had.
+    """
     port = tensors.host_port()
     util = np.zeros(port.shape, np.float32)
     if not link_util:
         return util
     index = tensors.index
-    by_dpid_port = {}
-    for (dpid, port_no), bps in link_util.items():
-        by_dpid_port[(index.get(dpid), port_no)] = bps
+    samples = [
+        (i, int(port_no), float(bps))
+        for (dpid, port_no), bps in link_util.items()
+        if bps and (i := index.get(dpid)) is not None
+    ]
+    if not samples:
+        return util
     rows, cols = np.nonzero(port >= 0)
-    for i, j in zip(rows, cols):
-        bps = by_dpid_port.get((i, int(port[i, j])))
-        if bps:
-            util[i, j] = bps
+    if not len(rows):
+        return util
+    s_rows, s_ports, s_bps = (np.asarray(x) for x in zip(*samples))
+    link_ports = port[rows, cols].astype(np.int64)
+    k = int(max(int(s_ports.max()), int(link_ports.max()))) + 1
+    link_key = rows.astype(np.int64) * k + link_ports
+    s_key = s_rows.astype(np.int64) * k + s_ports.astype(np.int64)
+    order = np.argsort(s_key)  # dict keys are unique: no stable need
+    s_key = s_key[order]
+    s_val = s_bps.astype(np.float32)[order]
+    pos = np.searchsorted(s_key, link_key)
+    pos_c = np.minimum(pos, len(s_key) - 1)
+    hit = s_key[pos_c] == link_key
+    util[rows[hit], cols[hit]] = s_val[pos_c[hit]]
     return util

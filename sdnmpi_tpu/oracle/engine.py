@@ -42,6 +42,18 @@ def _dist_span(dist, src, dst, n):
     return finite.any(), jnp.max(jnp.where(finite, sel, -jnp.inf))
 
 
+@jax.jit
+def _gather_links(base, li, lj):
+    """[E] per-link slice of a device-resident base-cost matrix (the
+    DAG engine's util vector input) — the device twin of the host
+    path's ``base[li, lj]`` fancy index. Link counts change only with
+    topology versions, so the shape-keyed jit cache stays tiny."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("util_gather_links")
+    return base[li, lj]
+
+
 def _timed_batch(op: str):
     """Record wall time + batch size of a routes_batch* invocation."""
     import functools
@@ -89,6 +101,17 @@ class TopoTensors:
     #: hand-built instances; fall back to np.asarray(adj/port).
     adj_host: np.ndarray | None = None
     port_host: np.ndarray | None = None
+    #: directed-link count, set by tensorize and maintained exactly by
+    #: the incremental repairs (adds/removes are pre-validated real
+    #: state changes), so per-call normalization never recounts the
+    #: [V, V] adjacency on host; -1 = unknown (hand-built instances)
+    n_links: int = -1
+
+    def link_count(self) -> int:
+        """Directed-link count without an O(V^2) host pass when known."""
+        if self.n_links < 0:
+            self.n_links = int((self.host_adj() > 0).sum())
+        return self.n_links
 
     @property
     def v(self) -> int:
@@ -194,6 +217,7 @@ def tensorize(db: "TopologyDB", pad_multiple: int = 8) -> TopoTensors:
         max_degree=max(8, ((out_degree + 7) // 8) * 8),
         adj_host=adj,
         port_host=port,
+        n_links=len(edges),
     )
 
 
@@ -298,14 +322,22 @@ class RouteOracle:
         if n_edges > self.delta_repair_threshold:
             return False
         with STATS.timed("oracle_repair", version=db.version, n_edges=n_edges):
+            # materialized lazy host twins are PATCHED per delta (only
+            # the repaired rows/columns cross the device link) instead
+            # of being invalidated and re-downloaded whole on the next
+            # host query; twins that were never materialized stay lazy.
+            # First materialization is a zero-copy read-only view of
+            # the device buffer (CPU backend), so patching promotes it
+            # to an owned writable copy once — still cheaper than the
+            # full re-download the old invalidate policy forced.
+            if self._dist_h is not None and not self._dist_h.flags.writeable:
+                self._dist_h = self._dist_h.copy()
+            if self._next_h is not None and not self._next_h.flags.writeable:
+                self._next_h = self._next_h.copy()
             self._dist_d, self._next_d = incremental.apply_repairs(
                 self._tensors, self._dist_d, self._next_d, self._order,
-                plan.edges,
+                plan.edges, dist_host=self._dist_h, next_host=self._next_h,
             )
-            # repaired matrices invalidate the lazy host twins; the
-            # adjacency/port host twins were patched in place
-            self._dist_h = None
-            self._next_h = None
             if plan.clear_memo:
                 self._endpoint_memo = {}
             self._version = db.version
@@ -563,19 +595,35 @@ class RouteOracle:
         )
 
     def _normalized_base(
-        self, t: TopoTensors, link_util, alpha: float, link_capacity: float,
-        n_rows: int,
-    ) -> np.ndarray:
+        self, db: "TopologyDB", t: TopoTensors, link_util, alpha: float,
+        link_capacity: float, n_rows: int,
+    ):
         """Normalize the Monitor's bps samples into flow-equivalent units
         (fraction of link capacity x the batch's average per-link share)
         so measured utilization and the balancer's own accumulated load
-        are comparable magnitudes in ``cost = base + load``."""
-        from sdnmpi_tpu.oracle.congestion import utilization_matrix
+        are comparable magnitudes in ``cost = base + load``.
 
-        util = utilization_matrix(t, link_util or {})
-        n_links = max(1, int((t.host_adj() > 0).sum()))
+        ``link_util`` is either the raw ``(dpid, port) -> bps`` host
+        dict (rebuilt into a [V, V] numpy matrix per call — the
+        differential oracle) or a device-resident
+        :class:`~sdnmpi_tpu.oracle.utilplane.UtilPlane`, in which case
+        this is a pure device expression over the plane's published
+        epoch — no host rebuild, no [V, V] transfer, and repeat calls
+        between Monitor flushes hit the plane's scaled-base cache. Both
+        paths compute ``(util / cap) * alpha * share`` in the same f32
+        order, so their base costs agree bit-for-bit."""
+        from sdnmpi_tpu.oracle.congestion import utilization_matrix
+        from sdnmpi_tpu.oracle.utilplane import UtilPlane
+
+        n_links = max(1, t.link_count())
         per_link_share = max(1.0, n_rows / n_links)
-        return (util / max(link_capacity, 1.0)) * alpha * per_link_share
+        cap = max(link_capacity, 1.0)
+        if isinstance(link_util, UtilPlane):
+            link_util.sync(db, t)
+            link_util.flush()  # staged Monitor samples -> this epoch
+            return link_util.base(alpha, cap, per_link_share)
+        util = utilization_matrix(t, link_util or {})
+        return (util / cap) * alpha * per_link_share
 
     def _materialize_fdbs(
         self,
@@ -792,7 +840,12 @@ class RouteOracle:
         li, lj = np.nonzero(adj_host > 0)
         li = li.astype(np.int32)
         lj = lj.astype(np.int32)
-        util = np.ascontiguousarray(base[li, lj], dtype=np.float32)
+        if isinstance(base, jax.Array):
+            # resident utilization plane: gather the [E] link vector on
+            # device — the dense base never crosses the host link
+            util = _gather_links(base, jnp.asarray(li), jnp.asarray(lj))
+        else:
+            util = np.ascontiguousarray(base[li, lj], dtype=np.float32)
         traffic = np.zeros((t.v, t.v), np.float32)
         np.add.at(traffic, (dst_idx, src_idx), sub_w)
 
@@ -1005,7 +1058,9 @@ class RouteOracle:
         groups, group_subs, src_idx, dst_idx, sub_w = self._group_ecmp_subflows(
             rows, ecmp_ways
         )
-        base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
+        base = self._normalized_base(
+            db, t, link_util, alpha, link_capacity, len(rows)
+        )
         threshold = self.dag_flow_threshold if dag_threshold is None else dag_threshold
 
         if len(src_idx) >= threshold:
@@ -1079,7 +1134,9 @@ class RouteOracle:
         if max_len == 0:
             return results, 0, 0.0
 
-        base = self._normalized_base(t, link_util, alpha, link_capacity, len(rows))
+        base = self._normalized_base(
+            db, t, link_util, alpha, link_capacity, len(rows)
+        )
 
         inter, n1, n2 = self._adaptive_paths(
             t, src_idx, dst_idx, weight, base, max_len, rounds,
@@ -1252,7 +1309,7 @@ class RouteOracle:
                 np.zeros(n_sub, np.int32), endpoint_port=fport,
             )
 
-        base = self._normalized_base(t, link_util, alpha, link_capacity, f)
+        base = self._normalized_base(db, t, link_util, alpha, link_capacity, f)
         n_detours = 0
         inter_h = None
         if policy == "adaptive":

@@ -62,7 +62,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from sdnmpi_tpu.kernels.tiling import col_bucket
 from sdnmpi_tpu.oracle.apsp import INF, nexthop_cols
 from sdnmpi_tpu.utils.tracing import count_trace
 
@@ -249,9 +248,9 @@ def plan_repair(
 def _pad_cols(cols: np.ndarray, v: int) -> np.ndarray:
     """Bucket-pad a dirty-column index vector with V (dropped at the
     scatters, clipped at the gathers)."""
-    out = np.full(col_bucket(len(cols), v), v, dtype=np.int32)
-    out[: len(cols)] = cols
-    return out
+    from sdnmpi_tpu.kernels.tiling import bucket_pad
+
+    return bucket_pad(cols, v, v)[0]
 
 
 def apply_repairs(
@@ -260,6 +259,8 @@ def apply_repairs(
     nxt,
     order: Optional[np.ndarray],
     edges: list[tuple[str, int, int, int]],
+    dist_host: Optional[np.ndarray] = None,
+    next_host: Optional[np.ndarray] = None,
 ):
     """Apply a validated plan's edge repairs in order.
 
@@ -271,6 +272,18 @@ def apply_repairs(
     through is sliced from the host ``order`` cache (same construction
     as dag.neighbor_table, maintained row-wise below) — a small H2D
     upload per delta instead of a [V, V] device sort per kernel.
+
+    ``dist_host``/``next_host`` are the oracle's lazy [V, V] host twins
+    when already materialized: each delta patches only its dirty
+    destination columns (plus the delta's own next-hop row) in place —
+    a ``[V, C]`` slice over the device link instead of the full-matrix
+    re-download the old invalidate-on-repair policy forced on the next
+    host-side query (ROADMAP PR-1 "Next"). The patched twins are
+    bit-identical to a fresh download (asserted in
+    tests/test_incremental.py): add-relaxation changes distances only
+    in the improved columns, remove-repair only in the changed suspect
+    columns, and the next-hop kernels write exactly the dirty columns
+    and row ``u``.
     """
     v = tensors.v
     adj_h = tensors.host_adj()
@@ -294,6 +307,8 @@ def apply_repairs(
         if kind == "add":
             adj_h[ia, ib] = 1.0
             port_h[ia, ib] = port_no
+            if tensors.n_links >= 0:
+                tensors.n_links += 1
             tensors.adj, tensors.port = _set_link(
                 tensors.adj, tensors.port, u, w,
                 jnp.float32(1.0), np.int32(port_no),
@@ -303,6 +318,8 @@ def apply_repairs(
         else:  # remove
             adj_h[ia, ib] = 0.0
             port_h[ia, ib] = -1
+            if tensors.n_links >= 0:
+                tensors.n_links -= 1
             tensors.adj, tensors.port = _set_link(
                 tensors.adj, tensors.port, u, w,
                 jnp.float32(0.0), np.int32(-1),
@@ -333,5 +350,17 @@ def apply_repairs(
             )
         # the delta's own row always repairs: its neighbor set changed
         nxt = _nexthop_row(dist, nxt, u, valid, safe)
+
+        # patch the materialized host twins with exactly what this
+        # delta changed: the dirty destination columns and (for next
+        # hops) the delta's own row
+        if len(dirty):
+            cols_d = jnp.asarray(dirty)
+            if dist_host is not None:
+                dist_host[:, dirty] = np.asarray(dist[:, cols_d])
+            if next_host is not None:
+                next_host[:, dirty] = np.asarray(nxt[:, cols_d])
+        if next_host is not None:
+            next_host[ia, :] = np.asarray(nxt[u, :])
     return dist, nxt
 

@@ -1,0 +1,370 @@
+"""Device-resident utilization plane: the Monitor stream as an oracle
+input with zero per-call host rebuilds.
+
+Before this module every balanced/adaptive/collective routing call
+rebuilt the ``[V, V]`` utilization matrix on the host from the
+TopologyManager's ``link_util`` dict (a Python loop over all ports,
+oracle/congestion.utilization_matrix) and re-uploaded it — ~4 MB per
+call at V=1024, pure overhead on the steady-state hot path the north
+star cares about. FatPaths (arxiv 1906.10885) ties load-aware
+multipathing quality to the freshness of the load signal; DeltaPath
+(arxiv 1808.06893) shows incremental state maintenance beats
+recompute-from-scratch for this control-plane shape. This module
+applies both to the utilization input the same way oracle/incremental
+applies them to distances:
+
+- A persistent flat ``[V * V]`` f32 link-utilization tensor lives on
+  device alongside the oracle's dist/next tensors, updated **in place**
+  (functionally — see the double-buffer note) by one jitted scatter per
+  sample batch. The Monitor's ``EventPortStats`` stream is staged into
+  a host dict (latest sample per ``(dpid, port)``, O(1) per event) and
+  flushed as a vectorized ``(flat link index, bps)`` batch — padded to
+  a bounded power-of-two ladder (kernels/tiling.col_bucket), so
+  arbitrary sampling patterns compile O(log E) scatter shapes total,
+  never one per batch length (trace-count asserted in tests/bench).
+- Samples fold in with EWMA decay: ``u' = (1 - a) * u + a * sample``
+  with ``a = Config.util_ewma_alpha``. The default ``a = 1.0`` is pure
+  replacement — bit-identical to the host rebuild from the raw dict,
+  which is what the differential tests pin down; ``a < 1`` smooths
+  bursty counters. Decay is per *sample batch* that touches a link
+  (the Monitor's own delta cadence), not per wall-clock interval, and
+  links with no fresh sample keep their value — matching the host
+  dict's keep-last-sample semantics.
+- **Epoch double-buffering**: routing reads ``snapshot()``/``base()``
+  from the published epoch buffer while ingest keeps scattering into
+  the live buffer; ``flush`` publishes a new epoch. JAX arrays are
+  immutable, so a published snapshot stays internally consistent no
+  matter how many scatters land after it — the classic two-buffer swap
+  without the copy.
+- **Repair seam**: the ``(dpid, port) -> flat index`` map rides the
+  PR-1 TopologyDB delta log (``deltas_since``). Link adds/removes/
+  rewires remap keys and zero exactly the affected slots with one
+  bucketed clear-scatter; only a structural break (switch departure,
+  log overflow, node-set growth) triggers a rebuild — and the rebuild
+  *carries the surviving links' EWMA state over on device* (gather old
+  slots, scatter into the new layout) instead of forgetting it.
+
+``RouteOracle._normalized_base`` recognizes a plane and becomes a pure
+device expression: one cached ``(snapshot / capacity) * alpha * share``
+scale per (epoch, scale) — steady-state routing calls between Monitor
+flushes pay a dict lookup, not a [V, V] rebuild + transfer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sdnmpi_tpu.kernels.tiling import bucket_pad
+from sdnmpi_tpu.utils.tracing import count_trace
+
+
+# -- jitted kernels --------------------------------------------------------
+#
+# All index vectors arrive bucket-padded with the out-of-range sentinel
+# (>= V*V), which drops at the scatters and clamps at the gathers; keep/
+# gain arrive as traced f32 scalars, so one compile per (V, bucket).
+
+
+@jax.jit
+def _scatter_ewma(live, idx, bps, keep, gain):
+    """Fold one sample batch into the live buffer:
+    ``live[idx] = live[idx] * keep + bps * gain`` (keep = 1 - alpha,
+    gain = alpha). With alpha = 1 this stores the raw f32 sample —
+    exactly what the host rebuild writes, preserving bit-identity."""
+    count_trace("utilplane_scatter")
+    old = live[jnp.minimum(idx, live.shape[0] - 1)]
+    return live.at[idx].set(old * keep + bps * gain, mode="drop")
+
+
+@jax.jit
+def _clear_slots(live, idx):
+    """Zero the slots of removed/rewired links (exact, not EWMA-decayed:
+    a dead link's last sample must never keep biasing the base)."""
+    count_trace("utilplane_clear")
+    return live.at[idx].set(0.0, mode="drop")
+
+
+@jax.jit
+def _carry_slots(old_live, old_idx, new_idx, zeros):
+    """Structural rebuild: gather surviving links' utilization from the
+    old layout and scatter it into the new one — EWMA state survives a
+    retensorize without a host round-trip. ``zeros`` is the new-layout
+    zero buffer (its shape keys the compile)."""
+    count_trace("utilplane_carry")
+    vals = old_live[jnp.minimum(old_idx, old_live.shape[0] - 1)]
+    return zeros.at[new_idx].set(vals, mode="drop")
+
+
+@jax.jit
+def _scale_base(live, cap, alpha, share):
+    """Normalized base-cost matrix from the flat snapshot: the same
+    f32 expression order as the host path in
+    ``RouteOracle._normalized_base`` — ``(util / cap) * alpha * share``
+    — so device and host base costs agree bit-for-bit."""
+    count_trace("utilplane_base")
+    v = math.isqrt(live.shape[0])
+    return (live.reshape(v, v) / cap) * alpha * share
+
+
+def _pad_idx(
+    idx: np.ndarray, cap: int, vals: Optional[np.ndarray] = None
+) -> tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Bucket-pad a flat-index batch with the drop sentinel ``cap``
+    (the shared kernels/tiling contract), uploaded as device arrays."""
+    out, v = bucket_pad(idx, cap, cap, vals)
+    return jnp.asarray(out), None if v is None else jnp.asarray(v)
+
+
+class UtilPlane:
+    """Device-resident per-link utilization state (see module docstring).
+
+    Lifecycle: ``stage()`` per Monitor sample (host dict, O(1));
+    ``sync(db[, tensors])`` absorbs topology deltas through the delta
+    log (binding/rebuilding needs ``tensors``); ``flush()`` scatters the
+    staged batch and publishes a new epoch; ``base()``/``snapshot()``
+    read the published epoch. The oracle drives sync/flush/base from
+    ``_normalized_base``; the TopologyManager additionally flushes on
+    the Monitor's end-of-pass edge so routing usually finds the epoch
+    already current.
+    """
+
+    def __init__(self, ewma_alpha: float = 1.0) -> None:
+        self.ewma_alpha = float(ewma_alpha)
+        #: published-epoch counter; bumps once per flush/rebuild
+        self.epoch = 0
+        #: latest staged sample per (dpid, port_no) since the last flush
+        self._staged: dict[tuple[int, int], float] = {}
+        #: (dpid, port_no) -> flat index into the [V*V] buffer
+        self._key_to_flat: dict[tuple[int, int], int] = {}
+        self._flat_to_key: dict[int, tuple[int, int]] = {}
+        #: dpid -> tensor row (copy of TopoTensors.index at bind)
+        self._dpid_row: dict[int, int] = {}
+        self._v = 0
+        self._live = None  # [V*V] f32 device buffer samples land in
+        self._snap = None  # published epoch buffer routing reads
+        self._version: Optional[int] = None  # TopologyDB version of the map
+        #: (alpha, cap, share) -> scaled [V, V] base, cleared per epoch
+        self._base_cache: dict[tuple, jax.Array] = {}
+        #: observability: structural rebuilds vs delta-log repairs vs
+        #: sample flushes (tests/bench assert steady state stays on the
+        #: repair + flush paths)
+        self.rebuild_count = 0
+        self.repair_count = 0
+        self.flush_count = 0
+
+    @property
+    def bound(self) -> bool:
+        return self._live is not None
+
+    # -- ingest -----------------------------------------------------------
+
+    def stage(self, key: tuple[int, int], bps: float) -> None:
+        """Stage one (dpid, port_no) -> bps sample for the next flush.
+        Later samples for the same key overwrite earlier ones (the EWMA
+        step applies per flushed batch, at the Monitor's cadence)."""
+        self._staged[key] = float(bps)
+
+    def drop(self, key: tuple[int, int]) -> None:
+        """Forget a staged sample (utilization hygiene: its link died)."""
+        self._staged.pop(key, None)
+
+    def flush(self) -> None:
+        """Scatter the staged batch into the live buffer and publish a
+        new epoch. Staged keys with no mapped link are discarded — the
+        host rebuild ignores them identically. No-op before binding."""
+        if self._live is None:
+            return
+        if self._staged:
+            idx: list[int] = []
+            bps: list[float] = []
+            for key, val in self._staged.items():
+                flat = self._key_to_flat.get(key)
+                if flat is not None:
+                    idx.append(flat)
+                    bps.append(val)
+            self._staged.clear()
+            if idx:
+                idx_p, bps_p = _pad_idx(
+                    np.asarray(idx, np.int32),
+                    self._v * self._v,
+                    np.asarray(bps, np.float32),
+                )
+                self._live = _scatter_ewma(
+                    self._live, idx_p, bps_p,
+                    np.float32(1.0 - self.ewma_alpha),
+                    np.float32(self.ewma_alpha),
+                )
+                self.flush_count += 1
+                self._publish()
+        if self._snap is None:
+            self._publish()
+
+    # -- topology repair seam ---------------------------------------------
+
+    def sync(self, db, tensors=None) -> bool:
+        """Bring the link-index map (and the affected slots) up to
+        ``db.version`` through the delta log. Returns True when the
+        plane is current; False when it needs ``tensors`` to (re)bind
+        and none were provided — staged samples are retained for the
+        next sync that has them."""
+        if self._version == db.version and self._live is not None:
+            return True
+        if self._live is None:
+            if tensors is None:
+                return False
+            self._rebuild(tensors, db.version)
+            return True
+        deltas_since = getattr(db, "deltas_since", None)
+        deltas = deltas_since(self._version) if deltas_since else None
+        if deltas is None:
+            if tensors is None:
+                return False
+            self._rebuild(tensors, db.version)
+            return True
+        dead: list[int] = []
+        for entry in deltas:
+            kind = entry[1]
+            if kind == "switch_upsert":
+                continue  # port-set refresh: the link map is untouched
+            if kind in ("switch_new", "host"):
+                if entry[2] not in self._dpid_row:
+                    # node set grew: row assignment shifts, map invalid
+                    if tensors is None:
+                        return False
+                    self._rebuild(tensors, db.version)
+                    return True
+                continue
+            if kind == "link+":
+                _, _, a, b, port_no = entry
+                ia = self._dpid_row.get(a)
+                ib = self._dpid_row.get(b)
+                if ia is None or ib is None:
+                    if tensors is None:
+                        return False
+                    self._rebuild(tensors, db.version)
+                    return True
+                flat = ia * self._v + ib
+                # fresh link or rewire: either way there is no sample
+                # yet under the (possibly new) port key, so the slot
+                # reads zero until the Monitor speaks — exactly what the
+                # host rebuild would show
+                self._remap(flat, (a, port_no))
+                dead.append(flat)
+            elif kind == "link-":
+                _, _, a, b = entry
+                ia = self._dpid_row.get(a)
+                ib = self._dpid_row.get(b)
+                if ia is None or ib is None:
+                    if tensors is None:
+                        return False
+                    self._rebuild(tensors, db.version)
+                    return True
+                flat = ia * self._v + ib
+                old = self._flat_to_key.pop(flat, None)
+                # drop the forward mapping only if it still points at
+                # THIS slot: under add-before-remove re-cabling (port p
+                # moved a->b to a->c, link+ logged first) the key
+                # already rebound to the new slot and must survive
+                if old is not None and self._key_to_flat.get(old) == flat:
+                    self._key_to_flat.pop(old, None)
+                dead.append(flat)
+            else:  # unknown delta kind from a future log version
+                if tensors is None:
+                    return False
+                self._rebuild(tensors, db.version)
+                return True
+        if dead:
+            idx_p, _ = _pad_idx(
+                np.asarray(sorted(set(dead)), np.int32), self._v * self._v
+            )
+            self._live = _clear_slots(self._live, idx_p)
+            self.repair_count += len(dead)
+            self._publish()
+        self._version = db.version
+        return True
+
+    def _remap(self, flat: int, key: tuple[int, int]) -> None:
+        old = self._flat_to_key.get(flat)
+        if old is not None and old != key:
+            self._key_to_flat.pop(old, None)
+        prev = self._key_to_flat.get(key)
+        if prev is not None and prev != flat:
+            # the key moved slots (port re-cabled to a new peer): clear
+            # its old slot's reverse entry so a later removal of that
+            # slot cannot strip the key's live mapping
+            if self._flat_to_key.get(prev) == key:
+                self._flat_to_key.pop(prev, None)
+        self._key_to_flat[key] = flat
+        self._flat_to_key[flat] = key
+
+    def _rebuild(self, tensors, version: int) -> None:
+        """(Re)bind to a TopoTensors snapshot: rebuild the index maps
+        from the port matrix and carry surviving links' utilization over
+        on device (rare — structural breaks only)."""
+        port = tensors.host_port()
+        dpids = tensors.dpids
+        v = tensors.v
+        new_map: dict[tuple[int, int], int] = {}
+        rows, cols = np.nonzero(port >= 0)
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            new_map[(int(dpids[r]), int(port[r, c]))] = r * v + c
+
+        zeros = jnp.zeros((v * v,), jnp.float32)
+        if self._live is not None and self._key_to_flat:
+            common = [k for k in new_map if k in self._key_to_flat]
+            if common:
+                old_idx = np.fromiter(
+                    (self._key_to_flat[k] for k in common), np.int32,
+                    len(common),
+                )
+                new_idx = np.fromiter(
+                    (new_map[k] for k in common), np.int32, len(common)
+                )
+                old_p, _ = _pad_idx(old_idx, v * v)
+                new_p, _ = _pad_idx(new_idx, v * v)
+                # pads gather a clamped junk value but scatter-drop it
+                zeros = _carry_slots(self._live, old_p, new_p, zeros)
+        self._live = zeros
+        self._key_to_flat = new_map
+        self._flat_to_key = {f: k for k, f in new_map.items()}
+        self._dpid_row = dict(tensors.index)
+        self._v = v
+        self._version = version
+        self.rebuild_count += 1
+        self._publish()
+
+    # -- reads (published epoch) ------------------------------------------
+
+    def _publish(self) -> None:
+        self._snap = self._live
+        self.epoch += 1
+        self._base_cache.clear()
+
+    def snapshot(self) -> jax.Array:
+        """[V, V] device view of the published epoch's raw bps state."""
+        return self._snap.reshape(self._v, self._v)
+
+    def base(self, alpha: float, cap: float, share: float) -> jax.Array:
+        """Normalized [V, V] base-cost tensor of the published epoch,
+        cached per (epoch, scale) — repeat routing calls between
+        Monitor flushes cost a dict lookup, not a device dispatch."""
+        key = (float(alpha), float(cap), float(share))
+        hit = self._base_cache.get(key)
+        if hit is None:
+            if len(self._base_cache) >= 8:
+                # the share term varies with batch size, so a stream of
+                # distinct batch shapes with no intervening Monitor
+                # flush (no epoch publish to clear the cache) must not
+                # accumulate [V, V] tensors without bound
+                self._base_cache.clear()
+            hit = _scale_base(
+                self._snap, np.float32(cap), np.float32(alpha),
+                np.float32(share),
+            )
+            self._base_cache[key] = hit
+        return hit

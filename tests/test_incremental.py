@@ -40,6 +40,9 @@ def _assert_matches_full(oracle, db):
     np.testing.assert_array_equal(t.host_adj(), tf.host_adj())
     np.testing.assert_array_equal(t.host_port(), tf.host_port())
     np.testing.assert_array_equal(oracle._order, full._order)
+    # the repair-maintained link count must track reality exactly (the
+    # utilization normalization reads it instead of recounting [V, V])
+    assert t.link_count() == tf.link_count() == int((t.host_adj() > 0).sum())
 
 
 def _cables(db):
@@ -242,3 +245,64 @@ def test_varying_batch_lengths_compile_once_per_bucket():
     assert TRACE_COUNTS["dist_span"] <= 2
     assert TRACE_COUNTS["batch_fdb"] <= 2
     assert TRACE_COUNTS["batch_paths"] <= 2
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "spec_fn",
+    [lambda: linear(8), lambda: fattree(4), lambda: torus2d(3, 3)],
+    ids=["linear8", "fattree4", "torus3x3"],
+)
+def test_repair_patches_host_twins_in_place(spec_fn, seed):
+    """Materialized lazy host twins survive in-place repair: only the
+    dirty columns (and the delta's next-hop row) cross the device link,
+    and the patched matrices are bit-identical to a fresh download —
+    the post-repair full re-download of ROADMAP's "Next" list is gone."""
+    rng = np.random.default_rng(seed)
+    db = spec_fn().to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    assert oracle._dist is not None and oracle._next is not None
+    assert oracle._dist_h is not None and oracle._next_h is not None
+
+    cables = _cables(db)
+    removed = None
+    for _ in range(8):
+        if removed is None:
+            removed = cables[int(rng.integers(len(cables)))]
+            for lk in removed:
+                db.delete_link(lk)
+        else:
+            for lk in removed:
+                db.add_link(lk)
+            removed = None
+        before = oracle.repair_count
+        oracle.refresh(db)
+        assert oracle.repair_count > before, "must stay on the repair path"
+        # twins were patched, not invalidated...
+        assert oracle._dist_h is not None and oracle._next_h is not None
+        # ...and match a full device download bit for bit
+        np.testing.assert_array_equal(
+            oracle._dist_h, np.asarray(oracle._dist_d)
+        )
+        np.testing.assert_array_equal(
+            oracle._next_h, np.asarray(oracle._next_d)
+        )
+    _assert_matches_full(oracle, db)
+
+
+def test_unmaterialized_twins_stay_lazy_through_repair():
+    """A repair on an oracle whose twins were never downloaded must not
+    materialize them as a side effect (large-topology remote-link
+    discipline)."""
+    db = fattree(4).to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    assert oracle._dist_h is None and oracle._next_h is None
+    cable = _cables(db)[1]
+    for lk in cable:
+        db.delete_link(lk)
+    before = oracle.repair_count
+    oracle.refresh(db)
+    assert oracle.repair_count > before
+    assert oracle._dist_h is None and oracle._next_h is None

@@ -1,0 +1,502 @@
+"""Device-resident utilization plane (oracle/utilplane.py).
+
+The contract under test: the persistent on-device [V, V] utilization
+tensor — scatter-updated from staged Monitor samples, maintained
+through the topology delta log, epoch double-buffered — produces base
+costs BIT-IDENTICAL to the vectorized host rebuild
+(oracle/congestion.utilization_matrix) on every routing entry point,
+across topology families, link deltas, and epoch flips. The host
+rebuild stays as the differential oracle; the plane is the steady-state
+production input (zero per-call host rebuilds).
+"""
+
+import numpy as np
+
+from sdnmpi_tpu.oracle.congestion import utilization_matrix
+from sdnmpi_tpu.oracle.utilplane import UtilPlane
+from sdnmpi_tpu.topogen import fattree, linear, torus
+
+
+def _all_link_samples(db, seed=0):
+    """(dpid, port) -> bps for every directed link, deterministic."""
+    rng = np.random.default_rng(seed)
+    samples = {}
+    for a in sorted(db.links):
+        for b in sorted(db.links[a]):
+            lk = db.links[a][b]
+            samples[(lk.src.dpid, lk.src.port_no)] = float(
+                rng.random() * 1e9
+            )
+    return samples
+
+
+def _staged_plane(samples, alpha=1.0):
+    plane = UtilPlane(ewma_alpha=alpha)
+    for key, bps in samples.items():
+        plane.stage(key, bps)
+    return plane
+
+
+def _assert_base_identical(db, oracle, t, plane, samples, n_rows=37):
+    dev = oracle._normalized_base(db, t, plane, 1.0, 10e9, n_rows)
+    host = oracle._normalized_base(db, t, samples, 1.0, 10e9, n_rows)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+
+
+def _cable(db, i=0):
+    """The i-th cable (both directed link entities) of the DB."""
+    cables = [
+        (db.links[a][b], db.links[b][a])
+        for a in sorted(db.links)
+        for b in sorted(db.links[a])
+        if a < b
+    ]
+    return cables[i]
+
+
+class TestBitIdenticalBase:
+    """Device scatter path == vectorized host rebuild, bit for bit."""
+
+    def _check_topology(self, spec):
+        db = spec.to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        samples = _all_link_samples(db)
+        plane = _staged_plane(samples)
+        _assert_base_identical(db, oracle, t, plane, samples)
+        # raw snapshot too, not just the normalized product
+        np.testing.assert_array_equal(
+            np.asarray(plane.snapshot()), utilization_matrix(t, samples)
+        )
+        return db, oracle, plane, samples
+
+    def test_linear(self):
+        self._check_topology(linear(5))
+
+    def test_fattree(self):
+        self._check_topology(fattree(4))
+
+    def test_torus(self):
+        self._check_topology(torus((3, 3)))
+
+    def test_after_link_deltas(self):
+        """Flap a cable: the removal zeroes exactly the dead slots via
+        the delta-log repair seam, the restore leaves them zero until a
+        fresh sample arrives — matching the host dict with the
+        TopologyManager's utilization hygiene applied."""
+        db, oracle, plane, samples = self._check_topology(fattree(4))
+        l1, l2 = _cable(db, 3)
+        for lk in (l1, l2):
+            db.delete_link(lk)
+            # mirror TopologyManager._drop_util hygiene
+            samples.pop((lk.src.dpid, lk.src.port_no), None)
+            plane.drop((lk.src.dpid, lk.src.port_no))
+        t = oracle.refresh(db)
+        _assert_base_identical(db, oracle, t, plane, samples)
+        assert plane.repair_count >= 2, "deltas must repair, not rebuild"
+        assert plane.rebuild_count == 1, "only the initial bind rebuilds"
+
+        for lk in (l1, l2):
+            db.add_link(lk)
+        t = oracle.refresh(db)
+        _assert_base_identical(db, oracle, t, plane, samples)
+
+        # fresh samples on the restored cable flow through again
+        for lk in (l1, l2):
+            key = (lk.src.dpid, lk.src.port_no)
+            samples[key] = 5e8
+            plane.stage(key, 5e8)
+        _assert_base_identical(db, oracle, t, plane, samples)
+        assert plane.rebuild_count == 1
+
+    def test_structural_break_rebuilds_with_carry_over(self):
+        """A switch departure breaks the delta log: the plane rebuilds
+        its index map from the new tensors and carries the surviving
+        links' utilization over ON DEVICE — still bit-identical to the
+        host rebuild from the (pruned) dict."""
+        db, oracle, plane, samples = self._check_topology(fattree(4))
+        victim = sorted(db.switches)[0]
+        # prune like the TopologyManager would: links first, then the
+        # switch (which breaks the log), then utilization hygiene
+        doomed = [
+            lk
+            for dst_map in db.links.values()
+            for lk in dst_map.values()
+            if victim in (lk.src.dpid, lk.dst.dpid)
+        ]
+        for lk in doomed:
+            db.delete_link(lk)
+        db.delete_switch(db.switches[victim])
+        for key in [k for k in samples if k[0] == victim]:
+            del samples[key]
+            plane.drop(key)
+        t = oracle.refresh(db)
+        _assert_base_identical(db, oracle, t, plane, samples)
+        assert plane.rebuild_count == 2, "log break must rebuild"
+
+    def test_scanner_dag_adaptive_collective_routes_identical(self):
+        """All four routing entry points produce identical results fed
+        by the plane vs fed by the host dict."""
+        db, oracle, plane, samples = self._check_topology(fattree(4))
+        macs = sorted(db.hosts)
+        pairs = [(macs[i], macs[(i + 5) % len(macs)]) for i in range(len(macs))]
+
+        assert oracle.routes_batch_balanced(
+            db, pairs, link_util=plane
+        ) == oracle.routes_batch_balanced(db, pairs, link_util=samples)
+        assert oracle.routes_batch_balanced(
+            db, pairs, link_util=plane, dag_threshold=1
+        ) == oracle.routes_batch_balanced(
+            db, pairs, link_util=samples, dag_threshold=1
+        )
+        assert oracle.routes_batch_adaptive(
+            db, pairs, link_util=plane
+        ) == oracle.routes_batch_adaptive(db, pairs, link_util=samples)
+
+        src_idx = np.arange(len(macs), dtype=np.int32)
+        dst_idx = (src_idx + 3) % len(macs)
+        ra = oracle.routes_collective(
+            db, macs, src_idx, dst_idx, link_util=plane
+        )
+        rb = oracle.routes_collective(
+            db, macs, src_idx, dst_idx, link_util=samples
+        )
+        assert ra.fdbs() == rb.fdbs()
+        assert ra.max_congestion == rb.max_congestion
+
+
+class TestEpochDoubleBuffer:
+    def test_published_snapshot_survives_later_ingest(self):
+        """Double-buffer contract: a snapshot taken at epoch N is
+        internally consistent forever — later scatters publish new
+        epochs without mutating it."""
+        db = fattree(4).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        samples = _all_link_samples(db)
+        plane = _staged_plane(samples)
+        plane.sync(db, t)
+        plane.flush()
+        e1 = plane.epoch
+        snap1 = np.asarray(plane.snapshot()).copy()
+        frozen = plane.snapshot()  # the device buffer routing would read
+
+        key = next(iter(samples))
+        plane.stage(key, 123456.0)
+        plane.flush()
+        assert plane.epoch > e1
+        snap2 = np.asarray(plane.snapshot())
+        assert not np.array_equal(snap1, snap2)
+        # the old epoch's buffer is untouched by the new scatter
+        np.testing.assert_array_equal(np.asarray(frozen), snap1)
+
+    def test_base_cached_within_epoch(self):
+        """Repeat routing calls between flushes reuse one scaled base
+        tensor — the steady-state per-call prep is a dict lookup."""
+        db = fattree(4).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        plane = _staged_plane(_all_link_samples(db))
+        b1 = oracle._normalized_base(db, t, plane, 1.0, 10e9, 16)
+        b2 = oracle._normalized_base(db, t, plane, 1.0, 10e9, 16)
+        assert b1 is b2
+        # a new epoch invalidates the cache
+        plane.stage((999, 999), 1.0)  # unmapped: discarded at flush...
+        key = next(iter(_all_link_samples(db)))
+        plane.stage(key, 777.0)  # ...but this one publishes a new epoch
+        b3 = oracle._normalized_base(db, t, plane, 1.0, 10e9, 16)
+        assert b3 is not b1
+
+
+class TestEwmaDecay:
+    def _bound_plane(self, alpha):
+        db = linear(3).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        plane = UtilPlane(ewma_alpha=alpha)
+        plane.sync(db, t)
+        key = next(iter(_all_link_samples(db)))
+        return db, t, plane, key
+
+    def _value(self, plane, key):
+        i, j = divmod(plane._key_to_flat[key], plane._v)
+        return float(np.asarray(plane.snapshot())[i, j])
+
+    def test_alpha_one_is_pure_replacement(self):
+        db, t, plane, key = self._bound_plane(1.0)
+        for bps in (100.0, 7.0, 3e9):
+            plane.stage(key, bps)
+            plane.flush()
+            assert self._value(plane, key) == np.float32(bps)
+
+    def test_fractional_alpha_smooths(self):
+        db, t, plane, key = self._bound_plane(0.25)
+        expected = np.float32(0.0)
+        for bps in (100.0, 200.0, 0.0, 400.0):
+            plane.stage(key, bps)
+            plane.flush()
+            expected = (
+                expected * np.float32(0.75)
+                + np.float32(bps) * np.float32(0.25)
+            )
+            assert self._value(plane, key) == expected
+
+    def test_quiet_flush_keeps_value(self):
+        """Decay applies per sample batch touching a link, not per
+        interval: a flush with no fresh sample for the link leaves it
+        untouched (keep-last-sample, like the host dict)."""
+        db, t, plane, key = self._bound_plane(0.5)
+        plane.stage(key, 100.0)
+        plane.flush()
+        before = self._value(plane, key)
+        other = [
+            k for k in _all_link_samples(db) if k != key
+        ][0]
+        plane.stage(other, 1.0)
+        plane.flush()
+        assert self._value(plane, key) == before
+
+
+class TestTraceBounds:
+    def test_no_per_batch_size_recompile(self):
+        """Varying sample-batch sizes ride the power-of-two bucket
+        ladder: the scatter kernel traces once per bucket, never once
+        per batch length (the probe the acceptance criteria name)."""
+        from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+        db = fattree(4).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        samples = list(_all_link_samples(db).items())
+        plane = UtilPlane()
+        plane.sync(db, t)
+        TRACE_COUNTS.clear()
+        buckets = set()
+        for n in (1, 2, 3, 5, 7, 8, 9, 13, 17, 25, 31, 33):
+            from sdnmpi_tpu.kernels.tiling import col_bucket
+
+            buckets.add(col_bucket(n, plane._v * plane._v))
+            for key, bps in samples[:n]:
+                plane.stage(key, bps + n)
+            plane.flush()
+        assert TRACE_COUNTS["utilplane_scatter"] <= len(buckets)
+
+
+class TestVectorizedHostFallback:
+    """The numpy utilization_matrix (the differential oracle) must keep
+    the exact semantics of the original per-entry loop."""
+
+    @staticmethod
+    def _loop_reference(tensors, link_util):
+        port = tensors.host_port()
+        util = np.zeros(port.shape, np.float32)
+        if not link_util:
+            return util
+        index = tensors.index
+        by_dpid_port = {}
+        for (dpid, port_no), bps in link_util.items():
+            by_dpid_port[(index.get(dpid), port_no)] = bps
+        rows, cols = np.nonzero(port >= 0)
+        for i, j in zip(rows, cols):
+            bps = by_dpid_port.get((i, int(port[i, j])))
+            if bps:
+                util[i, j] = bps
+        return util
+
+    def test_matches_loop_semantics(self):
+        from sdnmpi_tpu.oracle.engine import tensorize
+
+        db = fattree(4).to_topology_db(backend="jax")
+        t = tensorize(db)
+        samples = _all_link_samples(db)
+        # adversarial extras: unknown dpid, unmapped port, zero sample
+        samples[(999999, 1)] = 5.0
+        first = next(iter(samples))
+        samples[(first[0], 60000)] = 7.0
+        samples[first] = 0.0
+        np.testing.assert_array_equal(
+            utilization_matrix(t, samples),
+            self._loop_reference(t, samples),
+        )
+
+    def test_empty_and_no_links(self):
+        from sdnmpi_tpu.oracle.engine import tensorize
+
+        db = linear(2).to_topology_db(backend="jax")
+        t = tensorize(db)
+        assert utilization_matrix(t, {}).sum() == 0.0
+        assert utilization_matrix(t, {(999, 1): 3.0}).sum() == 0.0
+
+
+class TestClosedLoop:
+    """Monitor -> TopologyManager -> oracle through the real bus: the
+    plane is the utilization input the FindRoutesBatch seam actually
+    uses, and it steers like the host dict did."""
+
+    def _stack(self, **cfg):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from tests.test_control import make_diamond
+
+        fabric = make_diamond()
+        controller = Controller(
+            fabric, Config(oracle_backend="jax", **cfg)
+        )
+        controller.attach()
+        return fabric, controller
+
+    def _heat(self, fabric, controller, n_packets=40, t0=0.0):
+        from tests.test_control import MAC, ip_packet
+
+        controller.monitor.poll(now=t0)
+        for _ in range(n_packets):
+            fabric.hosts[MAC[1]].send(
+                ip_packet(MAC[1], MAC[4], payload=b"x" * 900)
+            )
+        controller.monitor.poll(now=t0 + 1.0)
+
+    def test_plane_feeds_routing_and_matches_host_dict(self):
+        from sdnmpi_tpu.control import events as ev
+        from tests.test_control import MAC
+
+        fabric, controller = self._stack()
+        tm = controller.topology_manager
+        assert tm.util_plane is not None
+        assert tm.routing_util() is tm.util_plane
+        self._heat(fabric, controller)
+
+        hot = 2 if tm.link_util.get((1, 2), 0) > 0 else 3
+        cold = 5 - hot
+        reply = controller.bus.request(
+            ev.FindRoutesBatchRequest([(MAC[1], MAC[4])], policy="balanced")
+        )
+        mids = [dpid for dpid, _ in reply.fdbs[0]]
+        assert cold in mids and hot not in mids, (
+            f"route {reply.fdbs[0]} must avoid the measured-hot arm {hot}"
+        )
+        # the device state mirrors the host dict exactly
+        oracle = tm.topologydb._jax_oracle()
+        t = oracle.refresh(tm.topologydb)
+        tm.util_plane.sync(tm.topologydb, t)
+        tm.util_plane.flush()
+        np.testing.assert_array_equal(
+            np.asarray(tm.util_plane.snapshot()),
+            utilization_matrix(t, tm.link_util),
+        )
+
+    def test_monitor_pass_flushes_bound_plane(self):
+        """Once bound, each Monitor pass lands as one epoch flip —
+        routing between passes reads a stable snapshot."""
+        from sdnmpi_tpu.control import events as ev
+        from tests.test_control import MAC
+
+        fabric, controller = self._stack()
+        tm = controller.topology_manager
+        self._heat(fabric, controller)
+        # first routing call binds the plane
+        controller.bus.request(
+            ev.FindRoutesBatchRequest([(MAC[1], MAC[4])], policy="balanced")
+        )
+        e0 = tm.util_plane.epoch
+        self._heat(fabric, controller, n_packets=10, t0=2.0)
+        assert tm.util_plane.epoch > e0, (
+            "Monitor EventStatsFlush must publish a new epoch"
+        )
+
+    def test_util_plane_off_falls_back_to_dict(self):
+        fabric, controller = self._stack(util_plane=False)
+        tm = controller.topology_manager
+        assert tm.util_plane is None
+        assert tm.routing_util() is tm.link_util
+
+    def test_restore_seeds_plane(self):
+        """Checkpoint restore stages the snapshotted utilization into
+        the plane, so the first post-restore route is congestion-aware."""
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+        from sdnmpi_tpu.control import events as ev
+        from tests.test_control import MAC
+
+        fabric, controller = self._stack()
+        self._heat(fabric, controller)
+        snap = snapshot_controller(controller)
+
+        fabric2, fresh = self._stack()
+        restore_controller(fresh, snap)
+        tm = fresh.topology_manager
+        assert tm.link_util == controller.topology_manager.link_util
+        hot = 2 if tm.link_util.get((1, 2), 0) > 0 else 3
+        cold = 5 - hot
+        reply = fresh.bus.request(
+            ev.FindRoutesBatchRequest([(MAC[1], MAC[4])], policy="balanced")
+        )
+        mids = [dpid for dpid, _ in reply.fdbs[0]]
+        assert cold in mids and hot not in mids
+
+
+class TestBenchMachinery:
+    """Config 9 machinery at test scale (the same discipline
+    test_churn_bench applies to config 8)."""
+
+    def test_scatter_stream_and_prep_compare(self):
+        from benchmarks.config9_utilplane import (
+            build,
+            prep_compare,
+            scatter_stream,
+        )
+
+        spec, db, oracle, t, plane, samples = build(k=4, v_pad=8)
+        ms, traces = scatter_stream(plane, samples, n_flushes=5)
+        assert len(ms) == 5 and (ms > 0).all()
+        assert traces == 0, "steady stream must not retrace the scatter"
+        res_ms, reb_ms = prep_compare(
+            db, oracle, t, plane, samples, n=3, n_rows=16
+        )
+        assert res_ms > 0 and reb_ms > 0
+
+    def test_balanced_compare_routes_identically(self):
+        from benchmarks.config9_utilplane import balanced_compare, build
+
+        spec, db, oracle, t, plane, samples = build(k=4, v_pad=8)
+        res_ms, reb_ms = balanced_compare(
+            db, oracle, plane, samples, n_pairs=16, iters=2
+        )
+        assert res_ms > 0 and reb_ms > 0
+
+
+class TestRecabling:
+    def test_add_before_remove_keeps_live_mapping(self):
+        """Port p re-cabled a->b to a->c with the link+ logged BEFORE
+        the link- (physical re-cabling order): the (a, p) key must stay
+        bound to the NEW slot — the stale a->b removal must not strip
+        it — and fresh samples land on the a->c link."""
+        from sdnmpi_tpu.core.topology_db import Link, Port
+
+        db = linear(4).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        samples = _all_link_samples(db)
+        plane = _staged_plane(samples)
+        _assert_base_identical(db, oracle, t, plane, samples)
+
+        dpids = sorted(db.switches)
+        a, b, c = dpids[1], dpids[2], dpids[0]  # 2 -> 3 becomes 2 -> 1
+        old = db.links[a][b]
+        p = old.src.port_no
+        # re-cable: add the new attachment first, then remove the old
+        db.add_link(Link(Port(a, p), Port(c, 99)))
+        db.delete_link(old)
+        samples.pop((a, p), None)  # TM hygiene drops the old link's util
+        plane.drop((a, p))
+        t = oracle.refresh(db)
+        _assert_base_identical(db, oracle, t, plane, samples)
+
+        # the key must still be live: a fresh sample reaches the a->c slot
+        samples[(a, p)] = 4.2e9
+        plane.stage((a, p), 4.2e9)
+        _assert_base_identical(db, oracle, t, plane, samples)
+        ia, ic = t.index[a], t.index[c]
+        assert float(np.asarray(plane.snapshot())[ia, ic]) == np.float32(4.2e9)
+        assert plane.rebuild_count == 1, "re-cabling must repair, not rebuild"
