@@ -41,6 +41,12 @@ same rows the suite driver collects as config 8.
 (config 9: steady-state sample-ingest latency and balanced routing
 with the device-resident utilization tensor vs the per-call host
 rebuild) and prints its BENCH-format JSON lines.
+
+``python bench.py pipeline`` runs the pipelined install-plane scenario
+(config 10: end-to-end packet-in -> last-byte-on-wire latency of a
+coalesced window stream, split-phase double-buffered windows +
+vectorized FlowMod materialization + batched wire encode vs the serial
+compute-then-install loop) and prints its BENCH-format JSON lines.
 """
 
 from __future__ import annotations
@@ -233,5 +239,9 @@ if __name__ == "__main__":
         from benchmarks.config9_utilplane import main as utilplane_main
 
         utilplane_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "pipeline":
+        from benchmarks.config10_pipeline import main as pipeline_main
+
+        pipeline_main()
     else:
         main()

@@ -25,6 +25,19 @@ from sdnmpi_tpu.oracle.apsp import apsp_distances
 from sdnmpi_tpu.oracle.engine import tensorize
 
 
+def _time_host(fn, n=3, windows=3):
+    """Median/best per-call ms of a host-side (numpy/native) stage —
+    no device sync games needed, just repeated wall clock."""
+    fn()  # warm (native lib load, allocator)
+    per = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        per.append((time.perf_counter() - t0) * 1e3 / n)
+    return float(np.median(per)), float(np.min(per))
+
+
 def _time(fn, n=10, windows=3):
     """Pipelined per-item device time for fn() -> jax array.
 
@@ -174,6 +187,83 @@ def main(topo: str = "fattree:32", pad_multiple: int = 128) -> None:
         )
     )
     log(f"  dst-restricted      {med:8.2f} ms  (best {best:.2f})")
+
+    # -- host stages: the install plane downstream of the oracle -------
+    # (what config 10 pipelines against the device compute: slot
+    # decode, fdb materialization, FlowMod wire encoding)
+    from sdnmpi_tpu import native
+    from sdnmpi_tpu.protocol import ofwire
+    from sdnmpi_tpu.protocol import openflow as of
+
+    buf = np.asarray(dag.route_collective(
+        t.adj, li, lj, util, traffic, usrc, udst,
+        levels=levels, rounds=2, max_len=max_len,
+        max_degree=t.max_degree, dist=dist, dst_nodes=dst_nodes,
+    ))
+    slots, _ = dag.unpack_result(buf, f, max_len)
+    order = native.neighbor_order(adj)
+    src32 = usrc_h.astype(np.int32)
+    dst32 = udst_h.astype(np.int32)
+    med, best = _time_host(
+        lambda: native.decode_slots(slots, order, src32, dst32, complete=True)
+    )
+    log(f"host decode_slots     {med:8.2f} ms  (best {best:.2f})")
+
+    paths = native.decode_slots(slots, order, src32, dst32, complete=True)
+    port_h = t.host_port()
+    fports = np.zeros(f, np.int32)
+    med, best = _time_host(
+        lambda: native.materialize_fdbs(paths, port_h, t.dpids, dst32, fports)
+    )
+    log(f"host materialize_fdbs {med:8.2f} ms  (best {best:.2f})")
+
+    # FlowMod wire encode on a coalescer-window-sized slice: batched
+    # numpy record assembly vs the per-message struct.pack loop it
+    # replaced (the serial/pipelined pair config 10 measures end to end)
+    od, op, ln = native.materialize_fdbs(paths, port_h, t.dpids, dst32, fports)
+    n_win = min(1024, f)
+    mask = np.arange(od.shape[1])[None, :] < ln[:n_win, None]
+    pair_idx, hop_idx = np.nonzero(mask)
+    keys = np.int64(0x020000000000) + np.arange(v, dtype=np.int64)
+    m_src = keys[src32[pair_idx]]
+    m_dst = keys[dst32[pair_idx]] | (1 << 41)
+    m_port = op[:n_win][pair_idx, hop_idx]
+    m_dpid = od[:n_win][pair_idx, hop_idx]
+
+    from sdnmpi_tpu.utils.arrays import group_spans
+
+    def encode_batched():
+        order_d = np.argsort(m_dpid, kind="stable")
+        blob, offsets = ofwire.encode_flow_mods_spans(of.FlowModBatch(
+            src=m_src[order_d], dst=m_dst[order_d],
+            out_port=m_port[order_d],
+        ))
+        # per-switch sends are byte spans of the one blob
+        return [
+            blob[int(offsets[lo]) : int(offsets[hi])]
+            for lo, hi in group_spans(m_dpid[order_d])
+        ]
+
+    med, best = _time_host(encode_batched)
+    log(f"host encode batched   {med:8.2f} ms  (best {best:.2f}) "
+        f"[{len(m_dpid):,} FlowMods]")
+
+    from sdnmpi_tpu.utils.mac import int_to_mac
+
+    src_macs = [int_to_mac(int(k)) for k in m_src]
+    dst_macs = [int_to_mac(int(k)) for k in m_dst]
+
+    def encode_scalar():
+        for i in range(len(m_dpid)):
+            ofwire.encode_flow_mod(of.FlowMod(
+                match=of.Match(dl_src=src_macs[i], dl_dst=dst_macs[i]),
+                actions=(of.ActionOutput(int(m_port[i])),),
+                priority=0x8000,
+            ))
+
+    med, best = _time_host(encode_scalar, n=1)
+    log(f"host encode scalar    {med:8.2f} ms  (best {best:.2f}) "
+        f"[per-message struct.pack twin]")
 
 
 def main_adaptive(topo: str = "dragonfly:8,32", n_flows: int = 10_000,

@@ -34,7 +34,12 @@ CONFIGS = [
     ("7", [sys.executable, "-m", "benchmarks.config7_torus"]),
     ("8", [sys.executable, "-m", "benchmarks.config8_churn"]),
     ("9", [sys.executable, "-m", "benchmarks.config9_utilplane"]),
+    ("10", [sys.executable, "-m", "benchmarks.config10_pipeline"]),
 ]
+
+#: keys every successful suite row must carry (error rows carry
+#: {config, error} instead) — the --json-schema-check contract
+REQUIRED_ROW_KEYS = ("config", "metric", "value", "unit")
 
 #: per-config wall clock cap (module-level so tests can shrink it)
 CONFIG_TIMEOUT_S = 1800
@@ -164,15 +169,85 @@ def _check_backend(probe) -> str | None:
     return f"backend wedged ({detail})"
 
 
+def check_rows(rows) -> list[str]:
+    """Schema violations of a suite row list ([] = clean).
+
+    A row is either an explicit failure ({config, error}) or a capture
+    carrying every REQUIRED_ROW_KEYS member with a numeric value —
+    anything else is a malformed row that would poison downstream
+    merges/plots silently."""
+    errors = []
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errors.append(f"row {i}: not an object ({row!r:.60})")
+            continue
+        where = f"row {i} (config {row.get('config', '?')})"
+        if "config" not in row:
+            errors.append(f"{where}: missing 'config'")
+        if "error" in row:
+            continue  # explicit failure rows carry {config, error}
+        missing = [
+            k for k in REQUIRED_ROW_KEYS if k != "config" and k not in row
+        ]
+        if missing:
+            errors.append(f"{where}: missing {missing}")
+        elif not isinstance(row.get("value"), (int, float)):
+            errors.append(
+                f"{where}: non-numeric value {row.get('value')!r}"
+            )
+    return errors
+
+
+def check_schema(root: pathlib.Path) -> list[str]:
+    """Validate every row-list BENCH_*.json under ``root`` (the suite
+    files; per-round driver logs like BENCH_r01.json hold a single
+    {n, cmd, rc, tail} object, not rows, and are skipped). Returns the
+    violation list ([] = clean)."""
+    errors = []
+    for path in sorted(root.glob("BENCH_*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except json.JSONDecodeError as e:
+            errors.append(f"{path.name}: bad JSON ({e})")
+            continue
+        if not isinstance(data, list):
+            continue  # round logs etc. — not row lists
+        errors.extend(f"{path.name}: {e}" for e in check_rows(data))
+    return errors
+
+
 def main() -> None:
     root = pathlib.Path(__file__).resolve().parent.parent
-    only = set(sys.argv[1:])  # e.g. `python -m benchmarks.run 4 6`
+    args = sys.argv[1:]
+    flags = {a for a in args if a.startswith("--")}
+    if unknown_flags := flags - {"--json-schema-check"}:
+        # a typo'd flag must not silently launch the full TPU suite
+        sys.exit(f"unknown flag(s) {sorted(unknown_flags)}")
+    schema_only = "--json-schema-check" in flags
+    only = {a for a in args if not a.startswith("--")}
     known = {name for name, _ in CONFIGS}
     if unknown := only - known:
         sys.exit(f"unknown config(s) {sorted(unknown)}; choose from {sorted(known)}")
+    if schema_only:
+        if only:
+            sys.exit(
+                "--json-schema-check validates the on-disk BENCH_*.json "
+                "rows and takes no config ids"
+            )
+        # validate without running anything — the pre-merge gate CI
+        # runs against BENCH_*.json
+        errors = check_schema(root)
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"json-schema-check: {len(errors)} violation(s)")
+        sys.exit(1 if errors else 0)
     results = run_suite(CONFIGS, root, only)
     failed = [r for r in results if "error" in r]
-    sys.exit(1 if failed else 0)
+    # post-run gate: whatever just landed must also be well-formed
+    errors = check_rows(results)
+    for e in errors:
+        print(e, file=sys.stderr)
+    sys.exit(1 if (failed or errors) else 0)
 
 
 if __name__ == "__main__":

@@ -144,6 +144,30 @@ class Config:
     #: max seconds a pending route lookup may wait for more batch
     #: companions before an enqueue triggers the flush itself
     coalesce_window_s: float = 0.005
+    #: split-phase pipelined install plane (control/router.py): coalesced
+    #: windows resolve through the oracle's non-blocking dispatch API
+    #: (DispatchRoutesBatchRequest), window k+1's device compute overlaps
+    #: window k's host decode + install, and each window's FlowMods are
+    #: materialized as numpy struct arrays feeding the batched wire
+    #: encoder (protocol/ofwire.encode_flow_mods_batch) — one send per
+    #: switch instead of one per hop. False restores the serial
+    #: resolve-then-install loop (the differential-testing path).
+    pipelined_install: bool = True
+    #: backpressure cap for batched FlowMod sends: a per-switch burst is
+    #: written to the wire in slices of at most this many bytes, with
+    #: the stalled-peer write-buffer check re-run between slices — one
+    #: giant install cannot overshoot the disconnect threshold by more
+    #: than a slice, and once a peer is cut the remainder of its burst
+    #: is dropped instead of written into the dead transport
+    #: (control/southbound.py)
+    install_highwater: int = 256 * 1024
+    #: wall-clock seconds after which a link with no fresh Monitor
+    #: sample decays toward zero in the device utilization plane (its
+    #: value halves on each flush past the horizon) — a silently dying
+    #: monitor must not pin its last reading into the balancer forever
+    #: (oracle/utilplane.py). 0 disables decay (keep-last-sample
+    #: semantics, bit-identical to the host dict rebuild).
+    util_stale_horizon_s: float = 0.0
 
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves

@@ -34,6 +34,9 @@ class Controller:
         self.topology_manager = TopologyManager(self.bus, southbound, config)
         self.process_manager = ProcessManager(self.bus, southbound, config)
         self.router = Router(self.bus, southbound, config)
+        if hasattr(southbound, "install_highwater"):
+            # batched-install backpressure cap (see OFSouthbound)
+            southbound.install_highwater = config.install_highwater
         if config.coalesce_routes:
             if hasattr(southbound, "on_idle"):
                 # route coalescing: the southbound's burst-drained edge

@@ -174,6 +174,41 @@ class FindRoutesBatchReply(Reply):
 
 
 @dataclasses.dataclass
+class DispatchRoutesBatchRequest(Request):
+    """Split-phase route resolution: the oracle's device program for the
+    batch is *launched* and the reply returns immediately with an
+    in-flight :class:`~sdnmpi_tpu.oracle.batch.RouteWindow`; the caller
+    reaps (host decode) later, overlapping the next window's device
+    compute — the dispatch leg of the pipelined install plane
+    (control/router.py flush_routes). Same pair/policy contract as
+    :class:`FindRoutesBatchRequest`."""
+
+    dst = "TopologyManager"
+    pairs: list  # [(src_mac, dst_mac), ...]
+    policy: str = "shortest"
+
+
+@dataclasses.dataclass
+class DispatchRoutesBatchReply(Reply):
+    window: Any  # oracle.batch.RouteWindow -> WindowRoutes
+
+
+@dataclasses.dataclass
+class UtilEpochRequest(Request):
+    """Published-epoch counter of the device utilization plane (0 when
+    no plane is configured). Flow revalidation reads it to skip
+    recomputes when neither the topology nor the utilization state
+    moved since its last pass (control/router.py)."""
+
+    dst = "TopologyManager"
+
+
+@dataclasses.dataclass
+class UtilEpochReply(Reply):
+    epoch: int
+
+
+@dataclasses.dataclass
 class FindCollectiveRoutesRequest(Request):
     """Array-native whole-collective routing: ``macs`` lists the N unique
     endpoints once, ``src_idx``/``dst_idx`` are [F] int indices into it.

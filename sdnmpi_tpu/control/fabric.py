@@ -549,6 +549,47 @@ class Fabric:
             )
         sw.flow_mod(mod)
 
+    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch) -> None:
+        """Per-switch FlowMod burst (see flow_mods_window)."""
+        import numpy as np
+
+        self.flow_mods_window(np.full(len(batch), dpid, np.int64), batch)
+
+    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> None:
+        """A whole window's FlowMods across switches (``dpids`` is the
+        [N] per-row switch id — the pipelined install plane's unit of
+        transfer). With ``wire=True`` the window round-trips through
+        ONE batched encode and the scalar per-message decoder over each
+        row's byte span — proving the exact bytes a real switch would
+        receive from OFSouthbound.flow_mods_window; otherwise the
+        scalar twins apply directly. Unknown dpids are skipped like
+        flow_mod's dead-datapath case."""
+        import numpy as np
+
+        dpids = np.asarray(dpids)
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            blob, offsets = ofwire.encode_flow_mods_spans(
+                batch, xid_base=self._xid + 1
+            )
+            self._xid += len(batch)
+            for i in range(len(dpids)):
+                sw = self.switches.get(int(dpids[i]))
+                if sw is None:
+                    log.debug("flow_mods_window row for unknown dpid dropped")
+                    continue
+                sw.flow_mod(ofwire.decode_flow_mod(
+                    blob[int(offsets[i]) : int(offsets[i + 1])]
+                ))
+            return
+        for dpid, mod in zip(dpids, batch.to_flow_mods()):
+            sw = self.switches.get(int(dpid))
+            if sw is None:
+                log.debug("flow_mods_window row for unknown dpid dropped")
+                continue
+            sw.flow_mod(mod)
+
     def flow_block_set(self, block: of.FlowBlockSet) -> None:
         """Install a whole collective's flows: partition the (sub-flow,
         hop) rows by switch with array ops, then hand each switch ONE

@@ -80,6 +80,13 @@ class Router:
         self._pending: list[_PendingRoute] = []
         self._pending_t0 = 0.0
         self._flushing = False
+        #: revalidation epoch gate: the TopologyDB version and UtilPlane
+        #: epoch as of the last completed revalidation pass. A repeat
+        #: EventTopologyChanged with neither advanced is a no-op, and
+        #: the delta log between passes narrows re-routing to the flows
+        #: whose installed paths touch a dirtied switch.
+        self._reval_version: int | None = None
+        self._reval_util_epoch: int = -1
 
         bus.subscribe(ev.EventDatapathUp, lambda e: self.dps.add(e.dpid))
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -251,34 +258,206 @@ class Router:
             self.flush_routes()
 
     def flush_routes(self) -> None:
-        """Resolve every pending route lookup in one batched oracle
-        call per ``coalesce_max_batch`` slice, then finish each parked
-        packet exactly as the direct path would (install + packet-out,
-        or controlled broadcast for routeless unicast). Loops until the
+        """Resolve every pending route lookup, one batched oracle call
+        per ``coalesce_max_batch`` slice, then finish each parked packet
+        exactly as the direct path would (install + packet-out, or
+        controlled broadcast for routeless unicast). Loops until the
         queue drains: packet-outs re-entering the data plane may park
-        new lookups mid-flush."""
+        new lookups mid-flush.
+
+        With ``Config.pipelined_install`` the windows are
+        *double-buffered* through the oracle's split-phase API
+        (DispatchRoutesBatchRequest): window k+1's device program is
+        dispatched BEFORE window k is reaped, so k+1 computes on device
+        while the host decodes, materializes, and installs k — the
+        device never idles between windows of a burst. Install order is
+        preserved (k always installs before k+1 is reaped)."""
         if self._flushing:
             return
         self._flushing = True
         try:
-            while self._pending:
+            prev: tuple[list[_PendingRoute], object] | None = None
+            while self._pending or prev is not None:
                 batch = self._pending[: self.config.coalesce_max_batch]
                 del self._pending[: len(batch)]
-                pairs = [(p.src, p.true_dst or p.dst) for p in batch]
-                reply = self.bus.request(ev.FindRoutesBatchRequest(pairs))
-                for p, fdb in zip(batch, reply.fdbs):
-                    if fdb:
-                        self._add_flows_for_path(fdb, p.src, p.dst, p.true_dst)
-                        self._send_packet_out(fdb, p.dpid, p.pkt, p.buffer_id)
-                    elif p.true_dst is None:
-                        # routeless unicast falls back to controlled
-                        # broadcast; routeless MPI flows drop, exactly
-                        # like the direct path (reference: router.py:186)
-                        self.bus.request(
-                            ev.BroadcastRequest(p.pkt, p.dpid, p.in_port)
+                window = None
+                if batch:
+                    pairs = [(p.src, p.true_dst or p.dst) for p in batch]
+                    window = self._dispatch_window(pairs)
+                    if window is None:
+                        # no split-phase provider on this bus (or
+                        # pipelining off): serial resolve-then-install
+                        if prev is not None:
+                            self._finish_batch(prev[0], prev[1].reap())
+                            prev = None
+                        reply = self.bus.request(
+                            ev.FindRoutesBatchRequest(pairs)
                         )
+                        from sdnmpi_tpu.oracle.batch import WindowRoutes
+
+                        self._finish_batch(
+                            batch, WindowRoutes.from_fdbs(reply.fdbs)
+                        )
+                        continue
+                # window k+1 is now in flight: reap + install window k
+                # while the device chews on k+1
+                if prev is not None:
+                    self._finish_batch(prev[0], prev[1].reap())
+                prev = (batch, window) if batch else None
         finally:
             self._flushing = False
+
+    def _dispatch_window(self, pairs, policy: str = "shortest"):
+        """Dispatch one window through the split-phase oracle API, or
+        None when the serial path must be used (pipelining disabled, or
+        a bus without the dispatch provider — e.g. minimal test
+        stacks)."""
+        if not self.config.pipelined_install:
+            return None
+        try:
+            return self.bus.request(
+                ev.DispatchRoutesBatchRequest(pairs, policy=policy)
+            ).window
+        except LookupError:
+            return None
+
+    def _finish_batch(self, batch: list[_PendingRoute], wr) -> None:
+        """Install one reaped window and finish its parked packets:
+        vectorized FlowMod materialization + batched install for the
+        whole window, then per-packet packet-out / broadcast fallback
+        (the per-packet leg is inherently scalar — one PacketOut each)."""
+        import numpy as np
+
+        routable = self._install_window(
+            [(p.src, p.dst, p.true_dst) for p in batch], wr
+        )
+        for k, p in enumerate(batch):
+            if routable[k]:
+                n = int(wr.hop_len[k])
+                hops = wr.hop_dpid[k, :n]
+                pos = np.nonzero(hops == p.dpid)[0]
+                if not pos.size:
+                    continue  # ingress switch not on the path
+                buffered = p.buffer_id != of.OFP_NO_BUFFER
+                self.southbound.packet_out(p.dpid, of.PacketOut(
+                    data=None if buffered else p.pkt,
+                    actions=(
+                        of.ActionOutput(int(wr.hop_port[k, pos[0]])),
+                    ),
+                    buffer_id=p.buffer_id,
+                ))
+            elif p.true_dst is None:
+                # routeless unicast falls back to controlled broadcast;
+                # routeless MPI flows drop, exactly like the direct
+                # path (reference: router.py:186)
+                self.bus.request(
+                    ev.BroadcastRequest(p.pkt, p.dpid, p.in_port)
+                )
+
+    def _install_window(self, entries, wr):
+        """Install a whole window's flows from its WindowRoutes arrays.
+
+        ``entries`` is ``[(src, dst, true_dst), ...]`` row-aligned with
+        ``wr``. The hop rows are flattened and masked with array ops
+        (live-datapath filter via ``np.isin``, last-hop rewrite
+        selection with one ``np.where``); FDB dedup/bookkeeping stays a
+        dict pass (it IS the dedup store), but builds no message
+        objects; the surviving rows are grouped by switch with one
+        ``np.argsort`` and the whole window ships as ONE
+        :class:`~sdnmpi_tpu.protocol.openflow.FlowModBatch` through
+        ``southbound.flow_mods_window`` — a single batched wire encode
+        whose per-switch byte spans the southbound flushes, instead of
+        one FlowMod dataclass + ``struct.pack`` per hop. Southbounds
+        with only the per-switch batch entry point get per-group
+        bursts; ones with neither fall back to the scalar per-hop
+        path. Returns the [F] bool routable mask."""
+        import numpy as np
+
+        ln = np.asarray(wr.hop_len)
+        routable = ln > 0
+        if not len(entries) or not routable.any():
+            return routable
+        if (
+            not self.config.pipelined_install
+            or not hasattr(self.southbound, "flow_mods_batch")
+        ):
+            # the genuine legacy leg — pipelined_install=False is the
+            # differential escape hatch and must reach the scalar
+            # per-hop FlowMod + per-message encode path, not just
+            # serialize the resolution
+            for k, (src, dst, true_dst) in enumerate(entries):
+                if routable[k]:
+                    self._add_flows_for_path(wr.fdb(k), src, dst, true_dst)
+            return routable
+
+        from sdnmpi_tpu.utils.mac import mac_to_int, macs_to_ints
+
+        f, l = wr.hop_dpid.shape
+        mask = np.arange(l)[None, :] < ln[:, None]
+        pair_idx, hop_idx = np.nonzero(mask)
+        dpid = wr.hop_dpid[pair_idx, hop_idx]
+        port = wr.hop_port[pair_idx, hop_idx]
+        last = hop_idx == ln[pair_idx] - 1
+        src_keys = macs_to_ints([e[0] for e in entries])
+        dst_keys = macs_to_ints([e[1] for e in entries])
+        rew_keys = np.array(
+            [mac_to_int(e[2]) if e[2] else -1 for e in entries], np.int64
+        )
+        m_src = src_keys[pair_idx]
+        m_dst = dst_keys[pair_idx]
+        m_rew = np.where(last, rew_keys[pair_idx], -1)
+        dps = np.fromiter(self.dps, np.int64, len(self.dps))
+        dps.sort()
+        live = np.isin(dpid, dps)
+
+        # dedup + FDB bookkeeping: dict ops only, one pass over the
+        # flat rows. Hops on dead datapaths are not recorded (recording
+        # them would dedup-suppress the install once the switch returns
+        # — same rule as _add_flows_for_path).
+        keep = np.zeros(len(dpid), bool)
+        for i in np.nonzero(live)[0]:
+            d = int(dpid[i])
+            src, dst, _ = entries[pair_idx[i]]
+            if self.fdb.exists(d, src, dst):
+                continue
+            p = int(port[i])
+            self.fdb.update(d, src, dst, p)
+            self.bus.publish(ev.EventFDBUpdate(d, src, dst, p))
+            keep[i] = True
+        if keep.any():
+            kd = dpid[keep]
+            order = np.argsort(kd, kind="stable")
+            kd = kd[order]
+            burst = of.FlowModBatch(
+                src=m_src[keep][order],
+                dst=m_dst[keep][order],
+                out_port=port[keep][order],
+                rewrite=m_rew[keep][order],
+                priority=self.config.priority_default,
+                idle_timeout=self.config.flow_idle_timeout,
+                hard_timeout=self.config.flow_hard_timeout,
+            )
+            window_send = getattr(self.southbound, "flow_mods_window", None)
+            if window_send is not None:
+                # one batched encode for the whole window; each switch
+                # gets its contiguous byte span (southbound slices it)
+                window_send(kd, burst)
+            else:
+                from sdnmpi_tpu.utils.arrays import group_spans
+
+                for lo, hi in group_spans(kd):
+                    self.southbound.flow_mods_batch(
+                        int(kd[lo]), of.FlowModBatch(
+                            src=burst.src[lo:hi],
+                            dst=burst.dst[lo:hi],
+                            out_port=burst.out_port[lo:hi],
+                            rewrite=burst.rewrite[lo:hi],
+                            priority=burst.priority,
+                            idle_timeout=burst.idle_timeout,
+                            hard_timeout=burst.hard_timeout,
+                        )
+                    )
+        return routable
 
     def _install_collective(self, vmac: VirtualMac) -> None:
         """Pre-route the whole collective in one load-balanced batch.
@@ -355,18 +534,32 @@ class Router:
         if not pairs:
             return
 
-        reply = self.bus.request(
-            ev.FindRoutesBatchRequest(pairs, policy=self.config.collective_policy)
+        window = self._dispatch_window(
+            pairs, policy=self.config.collective_policy
         )
+        if window is not None:
+            # split-phase + vectorized window install: the whole
+            # collective's FlowMods materialize as struct arrays and
+            # ship as per-switch batched bursts
+            wr = window.reap()
+            max_congestion = wr.max_congestion
+            self._install_window(todo, wr)
+        else:
+            reply = self.bus.request(
+                ev.FindRoutesBatchRequest(
+                    pairs, policy=self.config.collective_policy
+                )
+            )
+            max_congestion = reply.max_congestion
+            for (src_mac, pair_vmac, dst_mac), fdb in zip(todo, reply.fdbs):
+                if fdb:
+                    self._add_flows_for_path(fdb, src_mac, pair_vmac, dst_mac)
         log.info(
             "proactive install: collective %s, %d flows, max link load %s",
             vmac.coll_type,
             len(pairs),
-            reply.max_congestion,
+            max_congestion,
         )
-        for (src_mac, pair_vmac, dst_mac), fdb in zip(todo, reply.fdbs):
-            if fdb:
-                self._add_flows_for_path(fdb, src_mac, pair_vmac, dst_mac)
 
     def _install_collective_blocks(
         self,
@@ -532,12 +725,93 @@ class Router:
             return dst
         return self.bus.request(ev.RankResolutionRequest(vmac.dst_rank)).mac
 
+    def _reval_dirty_set(self):
+        """Narrow the next revalidation pass through the epoch gate.
+
+        Returns:
+        - an empty set: nothing advanced since the last pass (repeat
+          EventTopologyChanged with no TopologyDB version bump and no
+          UtilPlane epoch publish) — skip the pass entirely;
+        - a non-empty set of dpids: the delta log covers the gap with
+          pure link deltas, so only flows whose installed paths touch
+          one of these switches re-route. Deliberate trade-off: a link
+          ADD can in principle shorten paths that don't touch its
+          endpoints, and those flows keep their still-valid (possibly
+          no-longer-shortest) routes until a later delta dirties their
+          path, a full pass runs, or the flow's idle/hard timeout
+          recycles it — correctness (no flow rides a deleted link) is
+          what the narrowing preserves, global re-optimization is what
+          it defers, and re-running the oracle over every installed
+          flow per cable restore is exactly the cost this gate exists
+          to remove;
+        - None: no basis to narrow (first pass, broken/overflowed
+          delta log, host/switch membership deltas, or the utilization
+          plane moved under an unchanged graph) — full pass.
+
+        Precedence note: when the graph changed AND the utilization
+        plane also moved, the link-delta narrowing still applies — the
+        utilization epoch participates only in the skip/no-skip
+        decision for an unchanged graph. Utilization-driven
+        re-spreading of flows untouched by the link deltas is deferred
+        (they would not have re-routed at all without a topology event,
+        so this matches the pre-gate steady state); a pass that cannot
+        be narrowed re-spreads everything as before.
+        """
+        try:
+            db = self.bus.request(ev.CurrentTopologyRequest()).topology
+        except LookupError:
+            return None  # minimal stacks without a TopologyManager
+        try:
+            util_epoch = self.bus.request(ev.UtilEpochRequest()).epoch
+        except LookupError:
+            util_epoch = -1
+        version = getattr(db, "version", None)
+        if version is None:
+            return None  # duck-typed stand-in without the epoch counter
+        last_v, last_u = self._reval_version, self._reval_util_epoch
+        self._reval_version = version
+        self._reval_util_epoch = util_epoch
+        if last_v is None:
+            return None  # first pass: no baseline
+        if version == last_v:
+            # duplicate topology signal; skip unless utilization moved
+            return set() if util_epoch == last_u else None
+        deltas_since = getattr(db, "deltas_since", None)
+        deltas = deltas_since(last_v) if deltas_since else None
+        if deltas is None:
+            return None  # log broken (structural) or overflowed
+        dirty: set[int] = set()
+        for entry in deltas:
+            kind = entry[1]
+            if kind in ("link+", "link-"):
+                dirty.add(entry[2])
+                dirty.add(entry[3])
+            elif kind == "switch_upsert":
+                continue  # port-set refresh: the routed graph is unchanged
+            else:
+                # host moves / new switches shift endpoint resolution in
+                # ways the installed hop sets cannot express (the OLD
+                # edge switch of a moved host is not in the delta) —
+                # no narrowing
+                return None
+        return dirty
+
     def _revalidate_flows(self) -> None:
-        """Recompute every installed route after a topology change; tear
-        down hops that no longer lie on the chosen path and eagerly
-        reinstall the surviving routes. Block-installed collectives are
-        re-routed wholesale (one oracle call each) — their granularity
-        is the collective, not the pair."""
+        """Recompute installed routes after a topology change; tear down
+        hops that no longer lie on the chosen path and eagerly reinstall
+        the surviving routes. Block-installed collectives are re-routed
+        wholesale (one oracle call each) — their granularity is the
+        collective, not the pair.
+
+        Epoch-gated: a pass with neither the TopologyDB version nor the
+        UtilPlane epoch advanced since the last one is a no-op, and when
+        the PR-1 delta log covers the gap with pure link deltas, only
+        the flows whose installed paths touch a dirtied switch re-route
+        — a cable flap on one spine no longer re-runs the oracle over
+        every flow in the fabric."""
+        dirty = self._reval_dirty_set()
+        if dirty is not None and not dirty:
+            return  # nothing advanced since the last pass
         for install in self.collectives:
             self._remove_collective(install)
             self._reinstall_collective(install)
@@ -545,6 +819,11 @@ class Router:
         flows: dict[tuple[str, str], dict[int, int]] = {}
         for dpid, src, dst, port in self.fdb.entries():
             flows.setdefault((src, dst), {})[dpid] = port
+        if dirty is not None:
+            flows = {
+                pair: hops for pair, hops in flows.items()
+                if not dirty.isdisjoint(hops)
+            }
         if not flows:
             return
 

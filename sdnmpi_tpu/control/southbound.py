@@ -297,11 +297,16 @@ class OFSouthbound:
     #: the same stalled-peer policy as the RPC mirror's backlog cap
     MAX_WRITE_BUFFER = 4 * 1024 * 1024
 
-    def _send(self, dpid: int, payload: bytes) -> None:
+    def _send(self, dpid: int, payload: bytes) -> bool:
+        """Write one payload toward a datapath; returns False when the
+        bytes were NOT queued (unknown peer, or the stalled-peer cut
+        fired) so synchronous burst loops can stop early — the reader
+        task that prunes ``_writers`` cannot run mid-loop, so the
+        return value is the only in-loop liveness signal."""
         w = self._writers.get(dpid)
         if w is None:  # datapath died between event and send
             log.debug("send to unknown dpid %s dropped", dpid)
-            return
+            return False
         if w.transport.get_write_buffer_size() > self.MAX_WRITE_BUFFER:
             log.warning(
                 "datapath %#x stalled (%d bytes unsent); disconnecting",
@@ -311,11 +316,59 @@ class OFSouthbound:
             # stalled peer will never read, so connection_lost — and the
             # reader loop's datapath-down publication — would never fire
             w.transport.abort()
-            return
+            return False
         w.write(payload)  # drained by the connection's event loop
+        return True
 
     def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
         self._send(dpid, ofwire.encode_flow_mod(mod, xid=self._next_xid()))
+
+    #: byte cap per batched-install write slice (Config.install_highwater;
+    #: the Controller overrides this from its config). Slicing exists to
+    #: re-arm the stalled-peer write-buffer check between slices: one
+    #: giant burst cannot overshoot MAX_WRITE_BUFFER by more than a
+    #: slice, and once the cut fires the rest of the burst is dropped
+    #: instead of being pushed into the aborted transport.
+    install_highwater: int = 256 * 1024
+
+    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch) -> None:
+        """Install a whole per-switch FlowMod burst: ONE batched wire
+        encode (ofwire.encode_flow_mods_batch — numpy record assembly,
+        no per-message struct.pack) flushed with writev-style sliced
+        sends under the ``install_highwater`` backpressure cap. The
+        bytes on the wire are identical to ``len(batch)`` flow_mod
+        calls (asserted in tests/test_ofwire.py)."""
+        self.flow_mods_window(
+            np.full(len(batch), dpid, np.int64), batch
+        )
+
+    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> None:
+        """Install a whole *window's* FlowMods across switches: ``dpids``
+        is the [N] per-row switch id, grouped (equal dpids contiguous —
+        the Router's argsort guarantees it). The entire window is
+        serialized in ONE batched encode; each switch receives its
+        contiguous byte span of the blob (zero re-encoding per group),
+        sliced under the ``install_highwater`` backpressure cap with the
+        stalled-peer check re-armed between slices."""
+        dpids = np.asarray(dpids)
+        n = len(batch)
+        if n == 0:
+            return
+        from sdnmpi_tpu.utils.arrays import group_spans
+
+        blob, offsets = ofwire.encode_flow_mods_spans(
+            batch, xid_base=self._xid + 1
+        )
+        self._xid += n
+        step = max(1, int(self.install_highwater))
+        for lo, hi in group_spans(dpids):
+            dpid = int(dpids[lo])
+            span = blob[int(offsets[lo]) : int(offsets[hi])]
+            for off in range(0, len(span), step):
+                if not self._send(dpid, span[off : off + step]):
+                    # peer unknown or cut for stalling: drop the rest
+                    # of THIS switch's burst (other switches continue)
+                    break
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
         self._send(dpid, ofwire.encode_packet_out(out, xid=self._next_xid()))

@@ -69,7 +69,10 @@ class TopologyManager:
         if config.oracle_backend == "jax" and config.util_plane:
             from sdnmpi_tpu.oracle.utilplane import UtilPlane
 
-            self.util_plane = UtilPlane(config.util_ewma_alpha)
+            self.util_plane = UtilPlane(
+                config.util_ewma_alpha,
+                stale_horizon_s=config.util_stale_horizon_s,
+            )
         #: (dst_dpid, dst_port) -> (src_dpid, src_port) of the directed
         #: link arriving there, for attributing rx samples
         self._link_rev: dict[tuple[int, int], tuple[int, int]] = {}
@@ -90,6 +93,8 @@ class TopologyManager:
         bus.provide(ev.FindRouteRequest, self._find_route)
         bus.provide(ev.FindAllRoutesRequest, self._find_all_routes)
         bus.provide(ev.FindRoutesBatchRequest, self._find_routes_batch)
+        bus.provide(ev.DispatchRoutesBatchRequest, self._dispatch_routes_batch)
+        bus.provide(ev.UtilEpochRequest, self._util_epoch)
         bus.provide(ev.FindCollectiveRoutesRequest, self._find_routes_collective)
         bus.provide(ev.BroadcastRequest, self._broadcast_request)
 
@@ -177,6 +182,45 @@ class TopologyManager:
                 req.policy,
             )
         return ev.FindRoutesBatchReply(self.topologydb.find_routes_batch(req.pairs))
+
+    def _dispatch_routes_batch(
+        self, req: ev.DispatchRoutesBatchRequest
+    ) -> ev.DispatchRoutesBatchReply:
+        """Split-phase leg of _find_routes_batch: launch, don't decode.
+        Policy knobs are resolved from config exactly like the blocking
+        handler, so a dispatched window routes identically to the same
+        pairs through FindRoutesBatchRequest."""
+        cfg = self.config
+        kwargs = {}
+        if req.policy == "balanced":
+            kwargs = dict(
+                link_util=self.routing_util(),
+                alpha=cfg.congestion_alpha,
+                chunk=cfg.ecmp_chunk,
+                link_capacity=cfg.link_capacity_bps,
+                ecmp_ways=cfg.ecmp_ways,
+                rounds=cfg.balance_rounds,
+                dag_threshold=cfg.dag_flow_threshold,
+            )
+        elif req.policy == "adaptive":
+            kwargs = dict(
+                link_util=self.routing_util(),
+                ugal_candidates=cfg.ugal_candidates,
+                ugal_bias=cfg.ugal_bias,
+                alpha=cfg.congestion_alpha,
+                link_capacity=cfg.link_capacity_bps,
+                ecmp_ways=cfg.ecmp_ways,
+            )
+        return ev.DispatchRoutesBatchReply(
+            self.topologydb.find_routes_batch_dispatch(
+                req.pairs, policy=req.policy, **kwargs
+            )
+        )
+
+    def _util_epoch(self, req: ev.UtilEpochRequest) -> ev.UtilEpochReply:
+        return ev.UtilEpochReply(
+            self.util_plane.epoch if self.util_plane is not None else 0
+        )
 
     def _find_routes_collective(
         self, req: ev.FindCollectiveRoutesRequest

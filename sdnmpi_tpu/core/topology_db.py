@@ -381,6 +381,52 @@ class TopologyDB:
             )
         return [self.find_route(s, d) for s, d in pairs], 0, 0.0
 
+    def find_routes_batch_dispatch(
+        self,
+        pairs: list[tuple[str, str]],
+        policy: str = "shortest",
+        **kwargs,
+    ):
+        """Split-phase batch routing: launch the oracle's device program
+        and return a :class:`~sdnmpi_tpu.oracle.batch.RouteWindow`
+        immediately; ``reap()`` yields the window's ``WindowRoutes``
+        struct arrays. This is the dispatch leg of the pipelined install
+        plane (control/router.py flush_routes): window k+1's device
+        compute overlaps window k's host decode + install.
+
+        ``kwargs`` are the policy knobs of the blocking APIs
+        (link_util/alpha/chunk/link_capacity/ecmp_ways/rounds/
+        dag_threshold for "balanced"; the adaptive set for "adaptive").
+        Policies without a device dispatch leg — "adaptive" (its host
+        decode is interleaved), unknown policies, and the pure-Python
+        backend — come back as already-completed windows, so callers
+        need no special cases.
+        """
+        from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
+
+        if policy == "balanced":
+            if self.backend == "jax":
+                return self._jax_oracle().routes_batch_balanced_dispatch(
+                    self, pairs, **kwargs
+                )
+            # pure-Python backend: eager, but the congestion figure the
+            # blocking handler reports must ride the window too
+            fdbs, maxc = self.find_routes_batch_balanced(pairs, **kwargs)
+            return RouteWindow(result=WindowRoutes.from_fdbs(
+                fdbs, max_congestion=maxc,
+            ))
+        if policy == "adaptive":
+            fdbs, n_detours, maxc = self.find_routes_batch_adaptive(
+                pairs, **kwargs
+            )
+            return RouteWindow(result=WindowRoutes.from_fdbs(
+                fdbs, max_congestion=maxc, n_detours=n_detours,
+            ))
+        if self.backend == "jax":
+            return self._jax_oracle().routes_batch_dispatch(self, pairs)
+        fdbs = [self.find_route(s, d) for s, d in pairs]
+        return RouteWindow(result=WindowRoutes.from_fdbs(fdbs))
+
     def find_routes_collective(
         self,
         macs: list,

@@ -51,6 +51,111 @@ def pad_flow_batch(
     return tuple(out)
 
 
+class RouteWindow:
+    """Handle for one dispatched (possibly still in-flight) route
+    window — the split-phase contract of the pipelined install plane.
+
+    The oracle's ``*_dispatch`` entry points launch the window's device
+    program (JAX async dispatch: the call returns as soon as the
+    program is enqueued) and hand back one of these; :meth:`reap` runs
+    the host-side decode and blocks only on THIS window's results, so a
+    caller that dispatches window k+1 before reaping window k overlaps
+    k+1's device compute with k's host decode + install
+    (control/router.py flush_routes). Entry points with no device leg
+    (host chase, pure-Python backend, empty batches) return an
+    already-completed window; ``reap`` is idempotent either way.
+    """
+
+    __slots__ = ("_reap", "_result")
+
+    def __init__(self, reap=None, result=None):
+        self._reap = reap
+        self._result = result
+
+    @property
+    def done(self) -> bool:
+        return self._reap is None
+
+    def reap(self):
+        """Host decode of the dispatched window (blocking; idempotent)."""
+        if self._reap is not None:
+            self._result = self._reap()
+            self._reap = None
+        return self._result
+
+
+@dataclasses.dataclass
+class WindowRoutes:
+    """One resolved route window in struct-of-arrays form — the reap
+    result :class:`RouteWindow` yields for the batch (pair-list) entry
+    points. Row k is input pair k verbatim: ``hop_len[k] == 0`` marks
+    an unroutable/unresolved pair, otherwise ``hop_dpid[k, :hop_len[k]]``
+    / ``hop_port[k, :hop_len[k]]`` are its fdb hops with the final hop's
+    port already the destination's attachment port. The array form is
+    what the Router's vectorized FlowMod materialization consumes; the
+    list API (``fdbs()``) is the compat shim for the scalar paths.
+    """
+
+    hop_dpid: np.ndarray  # [F, L] int64, -1 padded
+    hop_port: np.ndarray  # [F, L] int32, -1 padded
+    hop_len: np.ndarray  # [F] int32 (0 = unroutable)
+    #: max discrete link load of the window's chosen paths (balanced)
+    max_congestion: float = 0.0
+    #: pairs detoured through a Valiant intermediate (adaptive policy)
+    n_detours: int = 0
+
+    @property
+    def n_pairs(self) -> int:
+        return self.hop_len.shape[0]
+
+    def fdb(self, k: int) -> list[tuple[int, int]]:
+        n = int(self.hop_len[k])
+        return [
+            (int(self.hop_dpid[k, h]), int(self.hop_port[k, h]))
+            for h in range(n)
+        ]
+
+    def fdbs(self) -> list[list[tuple[int, int]]]:
+        return [self.fdb(k) for k in range(self.n_pairs)]
+
+    def set_fdb(self, k: int, fdb: list[tuple[int, int]]) -> None:
+        """Overlay one pair's fdb list onto the arrays (scalar-fallback
+        merge); the hop axis grows when the list outruns it."""
+        need = len(fdb)
+        f, l = self.hop_dpid.shape
+        if need > l:
+            grow_d = np.full((f, need), -1, self.hop_dpid.dtype)
+            grow_p = np.full((f, need), -1, self.hop_port.dtype)
+            grow_d[:, :l] = self.hop_dpid
+            grow_p[:, :l] = self.hop_port
+            self.hop_dpid, self.hop_port = grow_d, grow_p
+        self.hop_len[k] = need
+        for h, (dpid, port) in enumerate(fdb):
+            self.hop_dpid[k, h] = dpid
+            self.hop_port[k, h] = port
+
+    @classmethod
+    def from_fdbs(
+        cls, fdbs: list[list[tuple[int, int]]], max_congestion: float = 0.0,
+        n_detours: int = 0,
+    ) -> "WindowRoutes":
+        """Array form of a list-of-fdb-lists result (host-chase / py
+        backend / legacy reply adaptation)."""
+        f = len(fdbs)
+        l = max((len(fdb) for fdb in fdbs), default=0) or 1
+        out = cls(
+            np.full((f, l), -1, np.int64),
+            np.full((f, l), -1, np.int32),
+            np.zeros(f, np.int32),
+            max_congestion=max_congestion,
+            n_detours=n_detours,
+        )
+        for k, fdb in enumerate(fdbs):
+            if fdb:
+                out.set_fdb(k, fdb)
+        return out
+
+
 @dataclasses.dataclass
 class CollectiveRoutes:
     """Routes for an F-pair collective, S sub-flows, paths up to L hops.

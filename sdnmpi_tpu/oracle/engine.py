@@ -76,6 +76,19 @@ def _pad(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def _start_host_copy(*arrays) -> None:
+    """Begin the async device->host transfer of dispatched results, so
+    the reap phase finds the bytes already (or nearly) landed instead of
+    paying the full device round-trip inside its blocking ``np.asarray``
+    — the transfer half of the dispatch/reap overlap. Backends without
+    the hint (or donated buffers) just fall back to the blocking copy."""
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except Exception:  # pragma: no cover - backend-dependent hint
+            pass
+
+
 @dataclasses.dataclass
 class TopoTensors:
     """Dense tensor form of a TopologyDB snapshot.
@@ -191,8 +204,20 @@ def tensorize(db: "TopologyDB", pad_multiple: int = 8) -> TopoTensors:
         port[li, lj] = pvals
 
     if jax.default_backend() == "cpu":
-        # host == device: a direct copy beats re-scattering
-        adj_d, port_d = jnp.asarray(adj), jnp.asarray(port)
+        # host == device: a direct copy beats re-scattering. The copy
+        # must be REAL: CPU device_put zero-copies suitably-aligned
+        # numpy buffers (alignment — and therefore whether it happens —
+        # varies with heap state), and these same arrays live on as the
+        # MUTABLE host twins that oracle/incremental.apply_repairs
+        # patches in place. An aliased buffer mutated by the host while
+        # an earlier async dispatch (the refresh APSP, a repair kernel)
+        # has not yet read it produces mixed-baseline tensors — the
+        # rare "repaired dist shows pre-removal connectivity" flake.
+        # Wrapping owned copies keeps whatever jax zero-copies private
+        # to jax. Regression-pinned by tests/test_incremental.py
+        # (test_device_tensors_never_alias_host_twins + the 100-step
+        # delta-replay stress).
+        adj_d, port_d = jnp.asarray(adj.copy()), jnp.asarray(port.copy())
     else:
         # remote accelerator: upload compact padded [E] edge vectors and
         # scatter on device — ~1/30th the H2D bytes of the dense pair,
@@ -672,6 +697,65 @@ class RouteOracle:
                 installed.append((k, g))
         return installed
 
+    def _materialize_window(
+        self,
+        t: TopoTensors,
+        groups: dict,
+        group_subs: dict,
+        paths: np.ndarray,
+        n_pairs: int,
+        results: list,
+    ):
+        """Per-pair array twin of :meth:`_materialize_fdbs`: the whole
+        window lands as a WindowRoutes (hop dpid/port/len struct arrays)
+        built with one native batch decode plus numpy gathers — no
+        per-pair Python hop lists. Pairs are dealt onto their group's
+        sub-flows round-robin exactly like the list path, and the final
+        hop's port is swapped for the pair's own attachment port with
+        one fancy-index store. The congestion figure counts each
+        installed pair once per link of its sub-flow path, matching
+        :meth:`_installed_congestion`."""
+        from sdnmpi_tpu import native
+        from sdnmpi_tpu.oracle.adaptive import link_loads
+        from sdnmpi_tpu.oracle.batch import WindowRoutes
+
+        n_sub = paths.shape[0]
+        dst_sw = np.full(n_sub, -1, np.int32)
+        for key, (first, nsub) in group_subs.items():
+            dst_sw[first : first + nsub] = key[1]
+        od, op, ln = native.materialize_fdbs(
+            paths, self._port, t.dpids, dst_sw, np.zeros(n_sub, np.int32)
+        )
+
+        g_of_pair = np.full(n_pairs, -1, np.int64)
+        fport = np.full(n_pairs, -1, np.int32)
+        for key, members in groups.items():
+            first, nsub = group_subs[key]
+            for j, (k, final_port) in enumerate(members):
+                g_of_pair[k] = first + j % nsub
+                fport[k] = final_port
+        ok = g_of_pair >= 0
+        g_safe = np.where(ok, g_of_pair, 0)
+        ln_p = np.where(ok, ln[g_safe], 0).astype(np.int32)
+        od_p = od[g_safe]  # fancy index: owned copies, safe to edit
+        op_p = op[g_safe]
+        good = ln_p > 0
+        rows = np.nonzero(good)[0]
+        op_p[rows, ln_p[rows] - 1] = fport[rows]
+        od_p[~good] = -1
+        op_p[~good] = -1
+        counts = np.bincount(g_of_pair[rows], minlength=n_sub).astype(
+            np.float32
+        )
+        wr = WindowRoutes(
+            od_p, op_p, ln_p,
+            max_congestion=float(link_loads(paths, counts, t.v).max(initial=0.0)),
+        )
+        for k, fdb in enumerate(results):
+            if fdb:  # merge scalar fallbacks back in
+                wr.set_fdb(k, fdb)
+        return wr
+
     @staticmethod
     def _installed_congestion(
         paths: np.ndarray, installed: list[tuple[int, int]], v: int
@@ -732,16 +816,35 @@ class RouteOracle:
     ) -> list[list[tuple[int, int]]]:
         """Resolve a batch of (src_mac, dst_mac) pairs to fdbs.
 
-        Endpoint resolution happens on host; the hop/port extraction for
-        the whole batch is a single device call (oracle/paths.batch_fdb),
-        except for small batches, which chase the cached next-hop matrix
-        on the host with zero device round-trips.
+        Blocking list-API twin of :meth:`routes_batch_dispatch` —
+        dispatch and reap back to back, results as per-pair fdb lists.
         """
+        return self.routes_batch_dispatch(db, pairs).reap().fdbs()
+
+    @_timed_batch("routes_batch_dispatch")
+    def routes_batch_dispatch(
+        self, db: "TopologyDB", pairs: list[tuple[str, str]]
+    ):
+        """Split-phase batch routing: launch the device extraction and
+        return a :class:`~sdnmpi_tpu.oracle.batch.RouteWindow` whose
+        ``reap()`` yields the window's
+        :class:`~sdnmpi_tpu.oracle.batch.WindowRoutes` arrays.
+
+        Endpoint resolution happens on host; the hop/port extraction for
+        the whole batch is a single device call (oracle/paths.batch_fdb)
+        that is merely *enqueued* here — the device computes while the
+        caller installs the previous window, and ``reap()`` blocks only
+        on this window's transfer. Small batches chase the cached
+        next-hop matrix on the host with zero device round-trips and
+        come back as already-completed windows.
+        """
+        from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
+
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
         rows = self._resolve_rows(db, pairs, t, results)
         if not rows:
-            return results
+            return RouteWindow(result=WindowRoutes.from_fdbs(results))
 
         src_idx = np.array([r[1] for r in rows], dtype=np.int32)
         dst_idx = np.array([r[2] for r in rows], dtype=np.int32)
@@ -749,7 +852,7 @@ class RouteOracle:
 
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
-            return results
+            return RouteWindow(result=WindowRoutes.from_fdbs(results))
 
         # small batches chase on host — but only when BOTH host twins
         # are already (or cheaply) materialized; the chase body reads
@@ -774,12 +877,12 @@ class RouteOracle:
                     node = nxt
                 fdb.append((int(dpids[di]), int(fport)))
                 results[k] = fdb
-            return results
+            return RouteWindow(result=WindowRoutes.from_fdbs(results))
 
         from sdnmpi_tpu.oracle.batch import pad_flow_batch
 
         src_p, dst_p, fport_p = pad_flow_batch(src_idx, dst_idx, final_port)
-        nodes, ports, length = batch_fdb(
+        nodes_d, ports_d, length_d = batch_fdb(
             self._next_d,
             t.port,
             jnp.asarray(src_p),
@@ -787,17 +890,37 @@ class RouteOracle:
             jnp.asarray(fport_p),
             max_len,
         )
-        nodes = np.asarray(nodes)
-        ports = np.asarray(ports)
-        length = np.asarray(length)
-
+        _start_host_copy(nodes_d, ports_d, length_d)
+        pair_rows = np.array([r[0] for r in rows], dtype=np.int64)
+        n_pairs = len(pairs)
         dpids = t.dpids
-        for f, (k, _, _, _) in enumerate(rows):
-            results[k] = [
-                (int(dpids[nodes[f, h]]), int(ports[f, h]))
-                for h in range(int(length[f]))
-            ]
-        return results
+
+        def reap() -> WindowRoutes:
+            n_rows = len(pair_rows)
+            nodes = np.asarray(nodes_d)[:n_rows]
+            ports = np.asarray(ports_d)[:n_rows]
+            length = np.asarray(length_d)[:n_rows]
+            # width covers the device hop axis AND any scalar-fallback
+            # fdb a duck-typed endpoint forced through db.find_route
+            width = max(
+                [nodes.shape[1]] + [len(f) for f in results if f]
+            )
+            od = np.full((n_pairs, width), -1, np.int64)
+            op = np.full((n_pairs, width), -1, np.int32)
+            ln = np.zeros(n_pairs, np.int32)
+            safe = np.clip(nodes, 0, len(dpids) - 1)
+            od[pair_rows, : nodes.shape[1]] = np.where(
+                nodes >= 0, dpids[safe], -1
+            )
+            op[pair_rows, : ports.shape[1]] = ports
+            ln[pair_rows] = length
+            wr = WindowRoutes(od, op, ln)
+            for k, fdb in enumerate(results):
+                if fdb:  # merge scalar fallbacks back in
+                    wr.set_fdb(k, fdb)
+            return wr
+
+        return RouteWindow(reap)
 
     #: sub-flow count at or above which balanced batches route through
     #: the level-decomposed MXU balancer + fused sampler
@@ -822,11 +945,32 @@ class RouteOracle:
         max_len: int,
         rounds: int,
     ) -> np.ndarray:
-        """Route sub-flows via ``oracle/dag.route_collective``: one device
-        program (utilization scatter + level-decomposed MXU balancing +
-        fused path sampling + single packed readback), then the native
-        slot decode. Returns [S, >=max_len] int32 node paths (-1 padded),
-        the same shape contract as the greedy scanner's output.
+        """Dispatch + reap in one blocking call (see _dag_paths_dispatch)."""
+        return self._dag_paths_dispatch(
+            t, src_idx, dst_idx, sub_w, base, max_len, rounds
+        )()
+
+    def _dag_paths_dispatch(
+        self,
+        t: TopoTensors,
+        src_idx: np.ndarray,
+        dst_idx: np.ndarray,
+        sub_w: np.ndarray,
+        base: np.ndarray,
+        max_len: int,
+        rounds: int,
+    ):
+        """Launch ``oracle/dag.route_collective`` for the sub-flow batch:
+        one device program (utilization scatter + level-decomposed MXU
+        balancing + fused path sampling + single packed readback),
+        returned as a zero-argument *reap* closure running the host-side
+        decode. JAX async dispatch means this method returns as soon as
+        the program is enqueued (the device-to-host copy is started
+        eagerly too), so a caller can overlap the next window's device
+        compute with this window's decode — the split-phase contract of
+        the pipelined install plane. The closure returns
+        [S, >=max_len] int32 node paths (-1 padded), the same shape
+        contract as the greedy scanner's output.
 
         With ``mesh_devices`` configured, the same program runs sharded
         over the device mesh (parallel/mesh.route_collective_sharded),
@@ -867,8 +1011,13 @@ class RouteOracle:
                 dst_nodes=jnp.asarray(dn) if use_dn else None,
             )
             assert slots_d.shape[1] == sampled_hops(max_len)
-            slots = np.asarray(slots_d)[: len(src_idx)]
-            return self._decode(slots, src_idx, dst_idx)
+            _start_host_copy(slots_d)
+
+            def reap_sharded() -> np.ndarray:
+                slots = np.asarray(slots_d)[: len(src_idx)]
+                return self._decode(slots, src_idx, dst_idx)
+
+            return reap_sharded
 
         # destination set of this batch: restricts the balancing matmuls
         # and the sampler's distance extraction to the rows that carry
@@ -902,8 +1051,13 @@ class RouteOracle:
             dist=self._dist_d,  # cached at this topology version: no BFS
             dst_nodes=jnp.asarray(dn) if len(dn) < t.v else None,
         )
-        slots, _ = unpack_result(np.asarray(buf), len(src_p), max_len)
-        return self._decode(slots[: len(src_idx)], src_idx, dst_idx)
+        _start_host_copy(buf)
+
+        def reap() -> np.ndarray:
+            slots, _ = unpack_result(np.asarray(buf), len(src_p), max_len)
+            return self._decode(slots[: len(src_idx)], src_idx, dst_idx)
+
+        return reap
 
     def _decode(self, slots, src_idx, dst_idx):
         """Shared slot decode of both DAG branches (C++ when built)."""
@@ -1047,13 +1201,40 @@ class RouteOracle:
         batch's average per-link share) so a hot link steers the balancer
         without overriding it outright.
         """
+        wr = self.routes_batch_balanced_dispatch(
+            db, pairs, link_util, alpha, chunk, link_capacity, ecmp_ways,
+            rounds, dag_threshold,
+        ).reap()
+        return wr.fdbs(), wr.max_congestion
+
+    @_timed_batch("routes_batch_balanced_dispatch")
+    def routes_batch_balanced_dispatch(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        alpha: float = 1.0,
+        chunk: int = 4096,
+        link_capacity: float = 10e9,
+        ecmp_ways: int = 4,
+        rounds: int = 2,
+        dag_threshold: Optional[int] = None,
+    ):
+        """Split-phase twin of :meth:`routes_batch_balanced`: the
+        balancing/sampling device program (DAG engine or greedy scanner,
+        same dispatch rule) is *enqueued* and a
+        :class:`~sdnmpi_tpu.oracle.batch.RouteWindow` returned; its
+        ``reap()`` runs the host decode + per-pair window
+        materialization and yields a ``WindowRoutes`` whose
+        ``max_congestion`` matches the blocking API's figure."""
+        from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
         from sdnmpi_tpu.oracle.congestion import route_flows_balanced
 
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
         rows = self._resolve_rows(db, pairs, t, results)
         if not rows:
-            return results, 0.0
+            return RouteWindow(result=WindowRoutes.from_fdbs(results))
 
         groups, group_subs, src_idx, dst_idx, sub_w = self._group_ecmp_subflows(
             rows, ecmp_ways
@@ -1066,15 +1247,15 @@ class RouteOracle:
         if len(src_idx) >= threshold:
             max_len = self._batch_max_len(src_idx, dst_idx, multiple=1)
             if max_len == 0:
-                return results, 0.0
-            paths = self._dag_paths(
+                return RouteWindow(result=WindowRoutes.from_fdbs(results))
+            paths_reap = self._dag_paths_dispatch(
                 t, src_idx, dst_idx, sub_w, base, max_len, rounds
             )
         else:
             max_len = self._batch_max_len(src_idx, dst_idx)
             if max_len == 0:
-                return results, 0.0
-            nodes, _, _ = route_flows_balanced(
+                return RouteWindow(result=WindowRoutes.from_fdbs(results))
+            nodes_d, _, _ = route_flows_balanced(
                 t.adj,
                 self._dist_d,  # cached device copy: no per-batch H2D
                 jnp.asarray(base.astype(np.float32)),
@@ -1085,10 +1266,19 @@ class RouteOracle:
                 chunk=chunk,
                 max_degree=t.max_degree,
             )
-            paths = np.asarray(nodes)
+            _start_host_copy(nodes_d)
 
-        installed = self._materialize_fdbs(t, groups, group_subs, paths, results)
-        return results, self._installed_congestion(paths, installed, t.v)
+            def paths_reap() -> np.ndarray:
+                return np.asarray(nodes_d)
+
+        n_pairs = len(pairs)
+
+        def reap() -> WindowRoutes:
+            return self._materialize_window(
+                t, groups, group_subs, paths_reap(), n_pairs, results
+            )
+
+        return RouteWindow(reap)
 
     @_timed_batch("routes_batch_adaptive")
     def routes_batch_adaptive(
@@ -1183,6 +1373,23 @@ class RouteOracle:
         src_idx: np.ndarray,
         dst_idx: np.ndarray,
         policy: str = "balanced",
+        **kwargs,
+    ):
+        """Blocking twin of :meth:`routes_collective_dispatch` —
+        dispatch and reap back to back; returns the collective's
+        :class:`~sdnmpi_tpu.oracle.batch.CollectiveRoutes`."""
+        return self.routes_collective_dispatch(
+            db, macs, src_idx, dst_idx, policy, **kwargs
+        ).reap()
+
+    @_timed_batch("routes_collective_dispatch")
+    def routes_collective_dispatch(
+        self,
+        db: "TopologyDB",
+        macs: list[str],
+        src_idx: np.ndarray,
+        dst_idx: np.ndarray,
+        policy: str = "balanced",
         link_util: Optional[dict[tuple[int, int], float]] = None,
         alpha: float = 1.0,
         link_capacity: float = 10e9,
@@ -1191,7 +1398,13 @@ class RouteOracle:
         ugal_candidates: int = 4,
         ugal_bias: float = 1.0,
     ):
-        """Route an entire collective given in compressed array form.
+        """Route an entire collective given in compressed array form,
+        split-phase: the device program is launched here (JAX async
+        dispatch) and the returned
+        :class:`~sdnmpi_tpu.oracle.batch.RouteWindow`'s ``reap()`` runs
+        the host decode (``unpack_result``/slot decode + native fdb
+        materialization) — so a caller can overlap collective k+1's
+        device compute with collective k's decode + install.
 
         ``macs`` lists the N unique endpoints once; ``src_idx``/``dst_idx``
         are [F] int32 indices into it — the caller (control/router.py)
@@ -1200,14 +1413,16 @@ class RouteOracle:
         resolution is O(N); grouping, ECMP sub-flow assignment, and the
         congestion metric are numpy array ops; path computation is the
         same device programs the list API uses (dag/adaptive/paths).
-        Returns a :class:`~sdnmpi_tpu.oracle.batch.CollectiveRoutes`.
+        The "adaptive" policy interleaves its own device/host stages, so
+        its window completes path computation at dispatch time; only the
+        materialization defers to reap.
 
         This replaces the reference's per-pair DFS-per-packet-in contract
         (reference: sdnmpi/util/topology_db.py:59-84 x 16.7M calls) with
         one resolve + one device program + one decode.
         """
         from sdnmpi_tpu.oracle.adaptive import link_loads
-        from sdnmpi_tpu.oracle.batch import CollectiveRoutes
+        from sdnmpi_tpu.oracle.batch import CollectiveRoutes, RouteWindow
 
         from sdnmpi_tpu import native
 
@@ -1240,11 +1455,11 @@ class RouteOracle:
             all_ok = bool(ok.all())  # skip F-sized boolean compressions
             # when every endpoint resolved (the common case)
             if not all_ok and not ok.any():
-                return CollectiveRoutes(
+                return RouteWindow(result=CollectiveRoutes(
                     np.full(f, -1, np.int32), final_port,
                     np.empty((0, 1), np.int64), np.empty((0, 1), np.int32),
                     np.zeros(0, np.int32), endpoint_port=fport,
-                )
+                ))
             sw_src_ok = src_sw if all_ok else src_sw[ok]
             sw_dst_ok = dst_sw if all_ok else dst_sw[ok]
             key = sw_src_ok * np.int64(t.v) + sw_dst_ok
@@ -1260,11 +1475,11 @@ class RouteOracle:
                     key, return_inverse=True, return_counts=True
                 )
         if not len(uniq):
-            return CollectiveRoutes(
+            return RouteWindow(result=CollectiveRoutes(
                 np.full(f, -1, np.int32), final_port,
                 np.empty((0, 1), np.int64), np.empty((0, 1), np.int32),
                 np.zeros(0, np.int32), endpoint_port=fport,
-            )
+            ))
 
         g_src = (uniq // t.v).astype(np.int32)
         g_dst = (uniq % t.v).astype(np.int32)
@@ -1302,15 +1517,14 @@ class RouteOracle:
 
         max_len = self._batch_max_len(sub_src, sub_dst, multiple=1)
         if max_len == 0:
-            return CollectiveRoutes(
+            return RouteWindow(result=CollectiveRoutes(
                 np.full(f, -1, np.int32), final_port,
                 np.full((n_sub, 1), -1, np.int64),
                 np.full((n_sub, 1), -1, np.int32),
                 np.zeros(n_sub, np.int32), endpoint_port=fport,
-            )
+            ))
 
         base = self._normalized_base(db, t, link_util, alpha, link_capacity, f)
-        n_detours = 0
         inter_h = None
         if policy == "adaptive":
             from sdnmpi_tpu.oracle.adaptive import stitch_paths
@@ -1319,22 +1533,28 @@ class RouteOracle:
                 t, sub_src, sub_dst, sub_w, base, max_len, rounds,
                 ugal_candidates, ugal_bias,
             )
-            paths = stitch_paths(n1, n2, inter_h)
+            stitched = stitch_paths(n1, n2, inter_h)
+
+            def paths_reap() -> np.ndarray:
+                return stitched
         elif policy == "shortest":
             from sdnmpi_tpu.oracle.batch import pad_flow_batch
 
             ssrc_p, sdst_p = pad_flow_batch(
                 sub_src.astype(np.int32), sub_dst.astype(np.int32)
             )
-            nodes, _ = batch_paths(
+            nodes_d, _ = batch_paths(
                 self._next_d,
                 jnp.asarray(ssrc_p),
                 jnp.asarray(sdst_p),
                 max_len,
             )
-            paths = np.asarray(nodes)[:n_sub]
+            _start_host_copy(nodes_d)
+
+            def paths_reap() -> np.ndarray:
+                return np.asarray(nodes_d)[:n_sub]
         else:  # balanced — the flagship MXU fast path
-            paths = self._dag_paths(
+            paths_reap = self._dag_paths_dispatch(
                 t,
                 sub_src.astype(np.int32),
                 sub_dst.astype(np.int32),
@@ -1344,27 +1564,32 @@ class RouteOracle:
                 rounds,
             )
 
-        od, op, ln = native.materialize_fdbs(
-            paths, self._port, t.dpids, sub_dst.astype(np.int32),
-            np.full(n_sub, -1, np.int32),  # final port is per pair, not per sub
-        )
+        sub_dst32 = sub_dst.astype(np.int32)
 
-        routes = CollectiveRoutes(
-            pair_sub, final_port, od, op, ln, endpoint_port=fport
-        )
-        # per-sub-flow routed-member counts without a boolean compress:
-        # shift ids by 1 so unresolved pairs (-1) land in bin 0, then
-        # zero the bins of unroutable sub-flows
-        counts_sub = np.bincount(
-            pair_sub.astype(np.int64) + 1, minlength=n_sub + 1
-        )[1:].astype(np.float32)
-        counts_sub[ln == 0] = 0.0
-        routes.max_congestion = float(
-            link_loads(paths, counts_sub, t.v).max(initial=0.0)
-        )
-        if inter_h is not None:
-            routes.n_detours = int(counts_sub[inter_h >= 0].sum())
-        return routes
+        def reap() -> CollectiveRoutes:
+            paths = paths_reap()
+            od, op, ln = native.materialize_fdbs(
+                paths, self._port, t.dpids, sub_dst32,
+                np.full(n_sub, -1, np.int32),  # final port is per pair
+            )
+            routes = CollectiveRoutes(
+                pair_sub, final_port, od, op, ln, endpoint_port=fport
+            )
+            # per-sub-flow routed-member counts without a boolean
+            # compress: shift ids by 1 so unresolved pairs (-1) land in
+            # bin 0, then zero the bins of unroutable sub-flows
+            counts_sub = np.bincount(
+                pair_sub.astype(np.int64) + 1, minlength=n_sub + 1
+            )[1:].astype(np.float32)
+            counts_sub[ln == 0] = 0.0
+            routes.max_congestion = float(
+                link_loads(paths, counts_sub, t.v).max(initial=0.0)
+            )
+            if inter_h is not None:
+                routes.n_detours = int(counts_sub[inter_h >= 0].sum())
+            return routes
+
+        return RouteWindow(reap)
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------
 

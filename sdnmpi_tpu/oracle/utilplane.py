@@ -90,6 +90,17 @@ def _clear_slots(live, idx):
 
 
 @jax.jit
+def _decay_slots(live, idx, factor):
+    """Scale the slots of stale links by ``factor`` — the wall-clock
+    horizon decay for monitors that die silently: each flush past the
+    horizon shrinks the orphaned reading again, driving it toward zero
+    instead of letting it steer the balancer forever."""
+    count_trace("utilplane_decay")
+    old = live[jnp.minimum(idx, live.shape[0] - 1)]
+    return live.at[idx].set(old * factor, mode="drop")
+
+
+@jax.jit
 def _carry_slots(old_live, old_idx, new_idx, zeros):
     """Structural rebuild: gather surviving links' utilization from the
     old layout and scatter it into the new one — EWMA state survives a
@@ -133,12 +144,27 @@ class UtilPlane:
     already current.
     """
 
-    def __init__(self, ewma_alpha: float = 1.0) -> None:
+    def __init__(
+        self, ewma_alpha: float = 1.0, stale_horizon_s: float = 0.0
+    ) -> None:
         self.ewma_alpha = float(ewma_alpha)
+        #: wall-clock seconds after which a link with no fresh sample
+        #: decays toward zero (halved per flush past the horizon) —
+        #: Config.util_stale_horizon_s; 0 keeps last-sample semantics
+        self.stale_horizon_s = float(stale_horizon_s)
         #: published-epoch counter; bumps once per flush/rebuild
         self.epoch = 0
         #: latest staged sample per (dpid, port_no) since the last flush
         self._staged: dict[tuple[int, int], float] = {}
+        #: wall-clock stamp of each key's last FLUSHED sample (only
+        #: tracked when the stale horizon is armed)
+        self._last_sample: dict[tuple[int, int], float] = {}
+        #: halvings applied per stale key since its last fresh sample;
+        #: at _DECAY_ROUNDS_MAX the slot is cleared to exact zero and
+        #: the key forgotten, so a permanently dead monitor costs a
+        #: bounded number of decay scatters (and epoch publishes) —
+        #: not one per flush forever
+        self._decay_rounds: dict[tuple[int, int], int] = {}
         #: (dpid, port_no) -> flat index into the [V*V] buffer
         self._key_to_flat: dict[tuple[int, int], int] = {}
         self._flat_to_key: dict[int, tuple[int, int]] = {}
@@ -156,6 +182,8 @@ class UtilPlane:
         self.rebuild_count = 0
         self.repair_count = 0
         self.flush_count = 0
+        #: stale-horizon decays applied (links x flushes past horizon)
+        self.decay_count = 0
 
     @property
     def bound(self) -> bool:
@@ -169,16 +197,31 @@ class UtilPlane:
         step applies per flushed batch, at the Monitor's cadence)."""
         self._staged[key] = float(bps)
 
+    #: halvings before a stale link is snapped to exact zero and its
+    #: decay clock dropped (2^-20 of any real bps reading is noise)
+    _DECAY_ROUNDS_MAX = 20
+
     def drop(self, key: tuple[int, int]) -> None:
         """Forget a staged sample (utilization hygiene: its link died)."""
         self._staged.pop(key, None)
+        self._last_sample.pop(key, None)
+        self._decay_rounds.pop(key, None)
 
-    def flush(self) -> None:
-        """Scatter the staged batch into the live buffer and publish a
-        new epoch. Staged keys with no mapped link are discarded — the
-        host rebuild ignores them identically. No-op before binding."""
+    def flush(self, now: Optional[float] = None) -> None:
+        """Scatter the staged batch into the live buffer, decay links
+        whose last sample fell off the stale horizon, and publish a new
+        epoch. Staged keys with no mapped link are discarded — the host
+        rebuild ignores them identically. ``now`` defaults to
+        ``time.monotonic()`` (tests pass explicit clocks). No-op before
+        binding."""
         if self._live is None:
             return
+        changed = False
+        horizon = self.stale_horizon_s
+        if horizon > 0 and now is None:
+            import time
+
+            now = time.monotonic()
         if self._staged:
             idx: list[int] = []
             bps: list[float] = []
@@ -187,6 +230,9 @@ class UtilPlane:
                 if flat is not None:
                     idx.append(flat)
                     bps.append(val)
+                    if horizon > 0:
+                        self._last_sample[key] = now
+                        self._decay_rounds.pop(key, None)
             self._staged.clear()
             if idx:
                 idx_p, bps_p = _pad_idx(
@@ -200,8 +246,40 @@ class UtilPlane:
                     np.float32(self.ewma_alpha),
                 )
                 self.flush_count += 1
-                self._publish()
-        if self._snap is None:
+                changed = True
+        if horizon > 0 and self._last_sample:
+            halve: list[int] = []
+            clear: list[int] = []
+            for k, ts in list(self._last_sample.items()):
+                if now - ts < horizon or k not in self._key_to_flat:
+                    continue
+                rounds = self._decay_rounds.get(k, 0) + 1
+                if rounds >= self._DECAY_ROUNDS_MAX:
+                    # decayed to noise: snap to exact zero and stop
+                    # tracking — a permanently dead monitor must not
+                    # cost a scatter + epoch publish per flush forever
+                    clear.append(self._key_to_flat[k])
+                    self._last_sample.pop(k)
+                    self._decay_rounds.pop(k, None)
+                else:
+                    self._decay_rounds[k] = rounds
+                    halve.append(self._key_to_flat[k])
+            if halve:
+                idx_p, _ = _pad_idx(
+                    np.asarray(sorted(halve), np.int32), self._v * self._v
+                )
+                self._live = _decay_slots(
+                    self._live, idx_p, np.float32(0.5)
+                )
+            if clear:
+                idx_p, _ = _pad_idx(
+                    np.asarray(sorted(clear), np.int32), self._v * self._v
+                )
+                self._live = _clear_slots(self._live, idx_p)
+            if halve or clear:
+                self.decay_count += len(halve) + len(clear)
+                changed = True
+        if changed or self._snap is None:
             self._publish()
 
     # -- topology repair seam ---------------------------------------------

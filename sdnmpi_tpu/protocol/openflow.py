@@ -91,6 +91,75 @@ class FlowMod:
 
 
 @dataclasses.dataclass(frozen=True)
+class FlowModBatch:
+    """A burst of exact-L2-match FlowMods for ONE switch, in
+    struct-of-arrays form — the install plane's unit of transfer.
+
+    Semantically this is N scalar :class:`FlowMod` messages
+    (``match=(dl_src, dl_dst)``, one output action, optional dl_dst
+    rewrite first — the Router's only install shapes), but member state
+    lives in numpy arrays so a whole coalesced window materializes with
+    array ops and serializes through the batched wire encoder
+    (protocol/ofwire.encode_flow_mods_batch) instead of N dataclass
+    constructions and N ``struct.pack`` calls. MACs travel as int48
+    keys (``utils.mac.mac_to_int`` form), never strings.
+
+    ``rewrite[i] >= 0`` appends a virtual -> real dl_dst rewrite before
+    the output on row i (last-hop MPI semantics, reference:
+    sdnmpi/router.py:98-102). With ``command=OFPFC_DELETE`` rows carry
+    no actions (out_port/rewrite are ignored). Priority, timeouts,
+    command, and cookie are shared by the burst — one switch, one
+    install pass, one policy.
+    """
+
+    src: "object"  # [N] int64 source MAC keys
+    dst: "object"  # [N] int64 destination (possibly virtual) MAC keys
+    out_port: "object"  # [N] int32 output ports
+    rewrite: Optional["object"] = None  # [N] int64 true-dst keys, -1 = none
+    priority: int = 0x8000
+    idle_timeout: int = 0
+    hard_timeout: int = 0
+    command: int = OFPFC_ADD
+    cookie: int = 0
+
+    def __len__(self) -> int:
+        return len(self.src)
+
+    def to_flow_mods(self):
+        """Yield the scalar FlowMod twin of each row — the semantic
+        reference the batched encoder is differentially tested against,
+        and the fallback for southbounds without a batch entry point."""
+        import numpy as np
+
+        from sdnmpi_tpu.utils.mac import int_to_mac
+
+        src = np.asarray(self.src)
+        dst = np.asarray(self.dst)
+        ports = np.asarray(self.out_port)
+        rew = None if self.rewrite is None else np.asarray(self.rewrite)
+        for i in range(len(src)):
+            actions: tuple[Action, ...] = ()
+            if self.command != OFPFC_DELETE:
+                out = ActionOutput(int(ports[i]))
+                if rew is not None and int(rew[i]) >= 0:
+                    actions = (ActionSetDlDst(int_to_mac(int(rew[i]))), out)
+                else:
+                    actions = (out,)
+            yield FlowMod(
+                match=Match(
+                    dl_src=int_to_mac(int(src[i])),
+                    dl_dst=int_to_mac(int(dst[i])),
+                ),
+                actions=actions,
+                priority=self.priority,
+                command=self.command,
+                idle_timeout=self.idle_timeout,
+                hard_timeout=self.hard_timeout,
+                cookie=self.cookie,
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FlowBlockSet:
     """Batch flow install for an entire collective — S ECMP sub-flow
     paths and their M member flows in ONE message of shared arrays.

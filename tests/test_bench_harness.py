@@ -140,6 +140,99 @@ def test_tpu_lock_short_acquire_after_hold_is_noop(tmp_path, monkeypatch):
         monkeypatch.setattr(common, "_TPU_LOCK_FD", None)
 
 
+class TestJsonSchemaCheck:
+    """--json-schema-check: every suite row must be {config, metric,
+    value, unit} (or an explicit {config, error} failure row) before it
+    merges — malformed rows poison downstream merges/plots silently."""
+
+    def test_clean_rows_pass(self):
+        assert bench_run.check_rows([
+            {"config": "1", "metric": "m", "value": 1.5, "unit": "ms"},
+            {"config": "2", "metric": "m", "value": 3, "unit": "x",
+             "vs_baseline": 2.0, "extra": "fine"},
+            {"config": "3", "error": "timeout"},
+        ]) == []
+
+    def test_violations_reported_per_row(self):
+        errors = bench_run.check_rows([
+            {"config": "1", "metric": "m", "value": 1.0, "unit": "ms"},
+            {"config": "2", "metric": "m"},  # missing value/unit
+            {"metric": "m", "value": 1.0, "unit": "ms"},  # missing config
+            {"config": "4", "metric": "m", "value": "fast", "unit": "ms"},
+            "not even a dict",
+        ])
+        assert len(errors) == 4
+        assert any("missing ['value', 'unit']" in e for e in errors)
+        assert any("missing 'config'" in e for e in errors)
+        assert any("non-numeric value" in e for e in errors)
+
+    def test_suite_files_scanned_and_round_logs_skipped(self, tmp_path):
+        (tmp_path / "BENCH_suite.json").write_text(json.dumps([
+            {"config": "1", "metric": "m", "value": 1.0, "unit": "ms"},
+            {"config": "2"},  # malformed capture
+        ]))
+        # per-round driver log: a single object, not a row list — skipped
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps({"n": 1, "cmd": "x", "rc": 0, "tail": ""})
+        )
+        (tmp_path / "BENCH_broken.json").write_text("{not json")
+        errors = bench_run.check_schema(tmp_path)
+        assert len(errors) == 2
+        assert any("BENCH_suite.json" in e and "config 2" in e
+                   for e in errors)
+        assert any("BENCH_broken.json" in e and "bad JSON" in e
+                   for e in errors)
+
+    def test_cli_gate(self, tmp_path, monkeypatch):
+        """The pre-merge CLI: exit 0 on a clean tree, 1 on violations,
+        without running any configs."""
+        import pathlib
+
+        ran = []
+        monkeypatch.setattr(bench_run, "run_suite",
+                            lambda *a, **k: ran.append(1) or [])
+        monkeypatch.setattr(
+            pathlib.Path, "resolve", lambda self: tmp_path / "x" / "y",
+        )
+        (tmp_path / "BENCH_suite.json").write_text(json.dumps([
+            {"config": "1", "metric": "m", "value": 1.0, "unit": "ms"},
+        ]))
+        monkeypatch.setattr(sys, "argv", ["run.py", "--json-schema-check"])
+        with pytest.raises(SystemExit) as e:
+            bench_run.main()
+        assert e.value.code == 0 and not ran
+        (tmp_path / "BENCH_suite.json").write_text(json.dumps([
+            {"config": "1", "metric": "m"},
+        ]))
+        with pytest.raises(SystemExit) as e:
+            bench_run.main()
+        assert e.value.code == 1 and not ran
+
+    def test_cli_rejects_flag_config_mix_and_typos(self, monkeypatch):
+        """--json-schema-check with config ids (or a typo'd flag) must
+        error out, never silently launch benchmarks against the TPU."""
+        ran = []
+        monkeypatch.setattr(bench_run, "run_suite",
+                            lambda *a, **k: ran.append(1) or [])
+        for argv in (["run.py", "--json-schema-check", "10"],
+                     ["run.py", "--json-schema-chek"]):
+            monkeypatch.setattr(sys, "argv", argv)
+            with pytest.raises(SystemExit) as e:
+                bench_run.main()
+            assert isinstance(e.value.code, str)  # usage error message
+        assert not ran
+
+    def test_run_results_gated_post_run(self, tmp_path):
+        """A config that emits structurally-bad JSON rows now fails the
+        harness even when its process exited 0."""
+        bad = [sys.executable, "-c", 'print(\'{"metric": "m"}\')']
+        rows = bench_run.run_suite(
+            [("1", bad)], tmp_path, timeout_s=10,
+            probe=lambda timeout_s=0: (True, "ok"),
+        )
+        assert bench_run.check_rows(rows)
+
+
 def test_partial_rerun_merges_not_clobbers(tmp_path):
     (tmp_path / "BENCH_suite.json").write_text(json.dumps([
         {"config": "1", "metric": "old1", "value": 9.0},

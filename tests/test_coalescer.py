@@ -42,15 +42,19 @@ def make_stack(**config_kw):
 
 
 def _count_batches(controller):
+    """Count batched route resolutions over the bus — both the legacy
+    blocking request and the split-phase dispatch the pipelined install
+    plane uses (one dispatched window == one batched oracle call)."""
     counts = {"n": 0, "sizes": []}
-    handler = controller.bus._request_handlers[ev.FindRoutesBatchRequest]
+    for req_type in (ev.FindRoutesBatchRequest, ev.DispatchRoutesBatchRequest):
+        handler = controller.bus._request_handlers[req_type]
 
-    def counting(req):
-        counts["n"] += 1
-        counts["sizes"].append(len(req.pairs))
-        return handler(req)
+        def counting(req, handler=handler):
+            counts["n"] += 1
+            counts["sizes"].append(len(req.pairs))
+            return handler(req)
 
-    controller.bus._request_handlers[ev.FindRoutesBatchRequest] = counting
+        controller.bus._request_handlers[req_type] = counting
     return counts
 
 

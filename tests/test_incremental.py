@@ -100,6 +100,75 @@ def test_random_delta_sequence_matches_full_recompute(spec_fn, seed):
     assert oracle.repair_count > 0
 
 
+def test_device_tensors_never_alias_host_twins():
+    """Root cause of the PR-2 flake: CPU device_put zero-copies
+    suitably-aligned numpy buffers, so tensorize's device adjacency/port
+    could alias the mutable host twins that apply_repairs patches in
+    place — and a host mutation racing an in-flight async dispatch
+    produced mixed-baseline dist/next (repaired dist keeping
+    pre-removal connectivity). The device tensors must be backed by
+    buffers the host never mutates: poking the twins (what a repair
+    does) must not show through to the device arrays."""
+    db = fattree(4).to_topology_db(backend="jax")
+    from sdnmpi_tpu.oracle.engine import tensorize
+
+    t = tensorize(db)
+    r, c = 0, 1
+    for host, dev in ((t.adj_host, t.adj), (t.port_host, t.port)):
+        before = np.asarray(dev[r, c]).item()
+        sentinel = before + 7
+        host[r, c] = sentinel
+        assert np.asarray(dev[r, c]).item() == before, (
+            "device tensor aliases its mutable host twin"
+        )
+        host[r, c] = before
+
+
+@pytest.mark.parametrize(
+    "spec_fn",
+    [lambda: linear(8), lambda: fattree(4)],
+    ids=["linear8", "fattree4"],
+)
+def test_seeded_delta_replay_stress_100x(spec_fn):
+    """Targeted hunt for the CHANGES.md PR-2 flake: one long-lived
+    oracle absorbs 100 seeded random delete/restore deltas in a single
+    process, and after EVERY repair the repaired distance matrix must
+    equal a from-scratch recompute bit for bit. The observed flake
+    (repaired dist showing pre-removal connectivity vs the full
+    recompute's partition) was a one-in-many-full-suite-runs event that
+    never reproduced in isolation — this replay pushes the same path two
+    orders of magnitude harder per run, so the nondeterminism either
+    reproduces here (with the step index in the failure message) or the
+    path is fenced."""
+    db = spec_fn().to_topology_db(backend="jax")
+    oracle = db._jax_oracle()
+    oracle.refresh(db)
+    rng = np.random.default_rng(0xC0FFEE)
+    down: list = []
+    for step in range(100):
+        cables = _cables(db)
+        if down and (not cables or rng.integers(2)):
+            for lk in down.pop(int(rng.integers(len(down)))):
+                db.add_link(lk)
+        else:
+            cable = cables[int(rng.integers(len(cables)))]
+            for lk in cable:
+                db.delete_link(lk)
+            down.append(cable)
+        oracle.refresh(db)
+        full = _fresh(db)
+        np.testing.assert_array_equal(
+            np.asarray(oracle._dist_d),
+            np.asarray(full._dist_d),
+            err_msg=(
+                f"repaired dist diverged from full recompute at step "
+                f"{step} ({len(down)} cables down)"
+            ),
+        )
+    assert oracle.full_refresh_count == 1, "stress must stay incremental"
+    assert oracle.repair_count >= 100
+
+
 def test_routes_stay_correct_through_repairs():
     """End-to-end: find_route answers against repaired tensors must
     match the pure-Python differential oracle after each delta."""

@@ -1,0 +1,399 @@
+"""Pipelined route->install dataplane (PR 3).
+
+The contracts under test:
+
+- the split-phase oracle API (dispatch/reap windows) routes exactly
+  like the blocking API, and large batches genuinely stay in flight
+  between dispatch and reap;
+- the Router's vectorized window install (struct arrays -> per-switch
+  FlowModBatch bursts) leaves switches, FDB, and delivered packets in
+  the SAME state as the legacy per-hop scalar install, including over
+  real wire bytes (``Fabric(wire=True)``) and for MPI last-hop rewrite
+  flows;
+- the OFSouthbound flushes batched installs in ``install_highwater``
+  byte slices (backpressure cap);
+- flow revalidation is epoch-gated: a repeat EventTopologyChanged with
+  neither the TopologyDB version nor the UtilPlane epoch advanced is a
+  no-op, and link deltas narrow re-routing to flows whose installed
+  paths touch a dirtied switch;
+- the config 10 bench machinery (serial vs pipelined install passes)
+  produces byte-identical install volume at test scale.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.fabric import Fabric
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+MAC = {i: f"04:00:00:00:00:0{i}" for i in (1, 2, 3, 4, 5, 6)}
+
+
+def make_line(wire=False):
+    """1 - 2 - 3 line with two hosts per edge switch, plus an isolated
+    4 - 5 pair (for dirty-set disjointness tests)."""
+    fabric = Fabric(wire=wire)
+    for d in (1, 2, 3, 4, 5):
+        fabric.add_switch(d)
+    fabric.add_link(1, 1, 2, 1)
+    fabric.add_link(2, 2, 3, 1)
+    fabric.add_link(4, 1, 5, 1)
+    fabric.add_host(MAC[1], 1, 2)
+    fabric.add_host(MAC[2], 1, 3)
+    fabric.add_host(MAC[3], 3, 2)
+    fabric.add_host(MAC[4], 3, 3)
+    fabric.add_host(MAC[5], 4, 2)
+    fabric.add_host(MAC[6], 5, 2)
+    return fabric
+
+
+def make_stack(backend="jax", wire=False, **config_kw):
+    fabric = make_line(wire=wire)
+    config_kw.setdefault("coalesce_routes", True)
+    config_kw.setdefault("coalesce_window_s", 10.0)
+    config_kw.setdefault("enable_monitor", False)
+    controller = Controller(
+        fabric, Config(oracle_backend=backend, **config_kw)
+    )
+    controller.attach()
+    return fabric, controller
+
+
+def flow_state(fabric):
+    """Canonical view of every switch's routing flow table (the default-
+    priority entries the Router installs), order-independent."""
+    state = set()
+    for dpid, sw in fabric.switches.items():
+        for e in sw.flow_table:
+            if e.priority == 0x8000:
+                state.add((dpid, e.match, e.actions, e.priority))
+    return state
+
+
+def _count_batches(controller):
+    counts = {"n": 0, "sizes": []}
+    for req_type in (ev.FindRoutesBatchRequest, ev.DispatchRoutesBatchRequest):
+        handler = controller.bus._request_handlers[req_type]
+
+        def counting(req, handler=handler):
+            counts["n"] += 1
+            counts["sizes"].append(len(req.pairs))
+            return handler(req)
+
+        controller.bus._request_handlers[req_type] = counting
+    return counts
+
+
+# -- split-phase oracle API -----------------------------------------------
+
+
+class TestDispatchReap:
+    def _db(self):
+        from sdnmpi_tpu.topogen import fattree
+
+        return fattree(4).to_topology_db(backend="jax")
+
+    def test_dispatch_matches_blocking_api(self):
+        db = self._db()
+        macs = sorted(db.hosts)
+        pairs = [
+            (macs[i], macs[(i * 5 + 3) % len(macs)]) for i in range(12)
+        ]
+        pairs = [(s, d) for s, d in pairs if s != d]
+        wr = db.find_routes_batch_dispatch(pairs).reap()
+        assert wr.fdbs() == db.find_routes_batch(pairs)
+
+    def test_balanced_dispatch_matches_blocking_api(self):
+        db = self._db()
+        macs = sorted(db.hosts)
+        pairs = [(a, b) for a in macs[:4] for b in macs[4:8]]
+        window = db.find_routes_batch_dispatch(pairs, policy="balanced")
+        wr = window.reap()
+        fdbs, maxc = db.find_routes_batch_balanced(pairs)
+        assert wr.fdbs() == fdbs
+        assert wr.max_congestion == maxc
+
+    def test_py_backend_balanced_window_carries_congestion(self):
+        """The eager py-backend window must report the same congestion
+        figure the blocking handler computes — not a hardwired zero."""
+        from sdnmpi_tpu.topogen import fattree
+
+        db = fattree(4).to_topology_db(backend="py")
+        macs = sorted(db.hosts)
+        pairs = [(macs[0], macs[-1]), (macs[1], macs[-2])]
+        wr = db.find_routes_batch_dispatch(pairs, policy="balanced").reap()
+        fdbs, maxc = db.find_routes_batch_balanced(pairs)
+        assert wr.fdbs() == fdbs
+        assert wr.max_congestion == maxc > 0
+
+    def test_large_batch_stays_in_flight_until_reaped(self):
+        """Past the host-chase budget the window must hold a live device
+        handle at dispatch time — the overlap the pipeline exists for —
+        and reap idempotently."""
+        db = self._db()
+        oracle = db._jax_oracle()
+        oracle.host_chase_hop_budget = 0  # force the device path
+        macs = sorted(db.hosts)
+        pairs = [(macs[0], macs[-1]), (macs[1], macs[-2])]
+        window = db.find_routes_batch_dispatch(pairs)
+        assert not window.done
+        wr = window.reap()
+        assert window.done
+        assert window.reap() is wr  # idempotent
+        assert wr.fdbs() == db.find_routes_batch(pairs)
+
+    def test_collective_dispatch_matches_blocking_api(self):
+        db = self._db()
+        macs = sorted(db.hosts)[:6]
+        src = np.array([0, 1, 2, 3, 4], np.int32)
+        dst = np.array([5, 4, 3, 2, 1], np.int32)
+        a = db.find_routes_collective(macs, src, dst, policy="balanced")
+        oracle = db._jax_oracle()
+        window = oracle.routes_collective_dispatch(
+            db, macs, src, dst, policy="balanced"
+        )
+        b = window.reap()
+        assert a.fdbs() == b.fdbs()
+        assert a.max_congestion == b.max_congestion
+
+    def test_window_routes_list_array_round_trip(self):
+        from sdnmpi_tpu.oracle.batch import WindowRoutes
+
+        fdbs = [[(1, 2), (3, 4)], [], [(9, 0xFFFE)]]
+        wr = WindowRoutes.from_fdbs(fdbs)
+        assert wr.fdbs() == fdbs
+        assert list(wr.hop_len) == [2, 0, 1]
+        wr.set_fdb(1, [(5, 1), (6, 2), (7, 3), (8, 4)])  # grows hop axis
+        assert wr.fdb(1) == [(5, 1), (6, 2), (7, 3), (8, 4)]
+        assert wr.fdb(0) == fdbs[0]
+
+
+# -- vectorized window install vs legacy scalar install --------------------
+
+
+class TestWindowInstallParity:
+    @pytest.mark.parametrize("wire", [False, True], ids=["sim", "wire"])
+    @pytest.mark.parametrize("backend", ["py", "jax"])
+    def test_same_flows_packets_and_fdb_as_serial(self, backend, wire):
+        pipe_fab, pipe_ctl = make_stack(backend, wire=wire)
+        ser_fab, ser_ctl = make_stack(
+            backend, wire=wire, pipelined_install=False
+        )
+        sends = [
+            (MAC[1], MAC[3]), (MAC[2], MAC[4]), (MAC[3], MAC[1]),
+            (MAC[5], MAC[6]),
+        ]
+        for fab in (pipe_fab, ser_fab):
+            for src, dst in sends:
+                fab.hosts[src].send(of.Packet(src, dst, payload=b"x"))
+        assert flow_state(pipe_fab) == flow_state(ser_fab)
+        assert set(pipe_ctl.router.fdb.entries()) == set(
+            ser_ctl.router.fdb.entries()
+        )
+        for _, dst in sends:
+            assert len(pipe_fab.hosts[dst].received) == len(
+                ser_fab.hosts[dst].received
+            )
+        # installed flows forward the next packet without the controller
+        pipe_fab.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[3], payload=b"y"))
+        assert len(pipe_fab.hosts[MAC[3]].received) == 2
+
+    def test_pipelined_off_restores_scalar_install_leg(self):
+        """pipelined_install=False is the differential escape hatch: the
+        install must run the legacy per-hop FlowMod path, never the
+        batched window encoder — even on southbounds that support it."""
+        fabric, controller = make_stack("py", pipelined_install=False)
+        batched = []
+        fabric.flow_mods_window = lambda *a, **k: batched.append(1)
+        fabric.flow_mods_batch = lambda *a, **k: batched.append(1)
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[3], payload=b"x"))
+        assert not batched
+        assert controller.router.fdb.exists(2, MAC[1], MAC[3])
+        assert len(fabric.hosts[MAC[3]].received) == 1
+
+    def test_mpi_flow_rewrites_on_last_hop(self):
+        """A virtual-MAC flow through the window installer must carry
+        the dl_dst rewrite on its final hop only — same as the scalar
+        path's last-hop special case."""
+        fabric, controller = make_stack("py")
+        for mac, rank in ((MAC[1], 0), (MAC[3], 1)):
+            fabric.hosts[mac].send(of.Packet(
+                mac, "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+                udp_dst=61000,
+                payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+            ))
+        vmac = VirtualMac(CollectiveType.P2P, 0, 1).encode()
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], vmac, payload=b"mpi"))
+        # delivered with the true MAC restored
+        assert fabric.hosts[MAC[3]].received[-1].eth_dst == MAC[3]
+        rewrites = {
+            dpid: [a for a in e.actions if isinstance(a, of.ActionSetDlDst)]
+            for dpid, sw in fabric.switches.items()
+            for e in sw.flow_table
+            if e.match.dl_dst == vmac
+        }
+        assert rewrites.pop(3) != []  # egress switch rewrites
+        assert all(not r for r in rewrites.values())  # transit does not
+
+    def test_window_install_dedups_against_fdb(self):
+        """Re-parking an already-installed pair must not reinstall it
+        (the SwitchFDB dedup survives the vectorized path)."""
+        fabric, controller = make_stack("py")
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[3], payload=b"a"))
+        before = flow_state(fabric)
+        counts = _count_batches(controller)
+        # force a second lookup for the same pair through the coalescer
+        controller.bus.publish(ev.EventPacketIn(
+            1, 2, of.Packet(MAC[1], MAC[3], payload=b"b"), of.OFP_NO_BUFFER
+        ))
+        controller.router.flush_routes()
+        assert counts["n"] == 1  # lookup happened...
+        assert flow_state(fabric) == before  # ...but nothing reinstalled
+
+    def test_dead_datapath_rows_not_recorded(self):
+        """Hops on a dead datapath are skipped AND not FDB-recorded, so
+        the install is not dedup-suppressed once the switch returns."""
+        fabric, controller = make_stack("py")
+        controller.router.dps.discard(2)  # switch 2's channel is down
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[3], payload=b"x"))
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[3])
+        assert controller.router.fdb.exists(1, MAC[1], MAC[3])
+
+
+# -- southbound backpressure ----------------------------------------------
+
+
+class TestBackpressure:
+    def test_batched_install_respects_highwater_slices(self):
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        sb._writers[1] = object()  # pretend the switch is connected
+        sent = []
+
+        def send(dpid, payload):
+            sent.append((dpid, len(payload)))
+            return True  # _send contract: bytes queued
+
+        sb._send = send
+        sb.install_highwater = 160  # two 80-byte messages per slice
+        n = 5
+        batch = of.FlowModBatch(
+            src=np.arange(n, dtype=np.int64),
+            dst=np.arange(n, dtype=np.int64) + 10,
+            out_port=np.ones(n, np.int32),
+        )
+        sb.flow_mods_batch(1, batch)
+        assert [s for _, s in sent] == [160, 160, 80]
+        assert all(d == 1 for d, _ in sent)
+        # xids advanced by the burst size, like n scalar flow_mods
+        assert sb._xid == n
+
+    def test_batched_install_stops_when_peer_cut(self):
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        sb._writers[1] = object()
+        sent = []
+
+        def send(dpid, payload):
+            sent.append(len(payload))
+            return False  # stalled-peer cut: bytes NOT queued
+
+        sb._send = send
+        sb.install_highwater = 80
+        batch = of.FlowModBatch(
+            src=np.arange(4, dtype=np.int64),
+            dst=np.arange(4, dtype=np.int64),
+            out_port=np.ones(4, np.int32),
+        )
+        sb.flow_mods_batch(1, batch)
+        assert len(sent) == 1  # remaining slices dropped
+
+
+# -- epoch-gated revalidation ---------------------------------------------
+
+
+class TestRevalidationGate:
+    def _warm_flow(self, fabric, controller):
+        fabric.hosts[MAC[1]].send(of.Packet(MAC[1], MAC[3], payload=b"x"))
+        assert controller.router.fdb.exists(2, MAC[1], MAC[3])
+
+    def test_duplicate_topology_signal_is_noop(self):
+        fabric, controller = make_stack("py")
+        self._warm_flow(fabric, controller)
+        counts = _count_batches(controller)
+        controller.bus.publish(ev.EventTopologyChanged())
+        assert counts["n"] == 1  # first pass: no baseline yet
+        controller.bus.publish(ev.EventTopologyChanged())
+        controller.bus.publish(ev.EventTopologyChanged())
+        assert counts["n"] == 1  # nothing advanced: skipped entirely
+
+    def test_disjoint_link_delta_reroutes_nothing(self):
+        fabric, controller = make_stack("py")
+        self._warm_flow(fabric, controller)
+        controller.bus.publish(ev.EventTopologyChanged())  # set baseline
+        counts = _count_batches(controller)
+        fabric.remove_link(4, 1, 5, 1)  # far from the 1-2-3 flow
+        assert counts["n"] == 0  # dirty set disjoint from installed hops
+        assert controller.router.fdb.exists(2, MAC[1], MAC[3])
+
+    def test_dirty_link_delta_reroutes_crossing_flows(self):
+        fabric, controller = make_stack("py")
+        self._warm_flow(fabric, controller)
+        controller.bus.publish(ev.EventTopologyChanged())  # set baseline
+        counts = _count_batches(controller)
+        # add a parallel cable on the flow's own span: dirty = {2, 3}
+        fabric.add_link(2, 7, 3, 7)
+        fabric.bus.publish(ev.EventTopologyChanged())
+        assert counts["n"] == 1 and counts["sizes"] == [1]
+
+    def test_link_failure_still_heals_flows(self):
+        """The gate must never break the PR-0 healing contract: cutting
+        a link on the path re-routes... and here there is no alternate
+        path, so the flow tears down."""
+        fabric, controller = make_stack("py")
+        self._warm_flow(fabric, controller)
+        fabric.remove_link(2, 2, 3, 1)
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[3])
+
+    def test_util_epoch_advance_defeats_skip(self):
+        """jax stack with a bound utilization plane: a duplicate
+        topology signal after a plane publish must NOT be skipped (the
+        balanced routes may want re-spreading)."""
+        fabric, controller = make_stack("jax")
+        self._warm_flow(fabric, controller)
+        controller.bus.publish(ev.EventTopologyChanged())  # baseline
+        counts = _count_batches(controller)
+        tm = controller.topology_manager
+        tm.util_plane.sync(tm.topologydb, None) or tm.util_plane._rebuild(
+            tm.topologydb._jax_oracle().refresh(tm.topologydb),
+            tm.topologydb.version,
+        )
+        tm.util_plane.stage((1, 1), 5e9)
+        tm.util_plane.flush()  # epoch publish
+        controller.bus.publish(ev.EventTopologyChanged())
+        assert counts["n"] == 1  # NOT skipped
+
+
+# -- config 10 bench machinery --------------------------------------------
+
+
+class TestPipelineBench:
+    def test_serial_and_pipelined_passes_agree(self):
+        from benchmarks.config10_pipeline import (
+            build, pipelined_pass, serial_pass, window_stream,
+        )
+
+        spec, db, oracle, t = build(k=4, v_pad=8)
+        windows = window_stream(db, n_windows=3, n_pairs=16, seed=3)
+        s_ms, s_n, s_b = serial_pass(db, oracle, windows)
+        p_ms, p_n, p_b = pipelined_pass(db, oracle, windows)
+        assert s_n == p_n > 0
+        assert s_b == p_b > 0
+        assert s_ms > 0 and p_ms > 0

@@ -257,6 +257,117 @@ class TestEwmaDecay:
         assert self._value(plane, key) == before
 
 
+class TestStaleDecay:
+    """Wall-clock stale-link decay (Config.util_stale_horizon_s): links
+    whose monitors die silently halve per flush past the horizon
+    instead of pinning their last reading into the balancer forever."""
+
+    def _bound_plane(self, horizon):
+        db = linear(3).to_topology_db(backend="jax")
+        oracle = db._jax_oracle()
+        t = oracle.refresh(db)
+        plane = UtilPlane(stale_horizon_s=horizon)
+        plane.sync(db, t)
+        keys = sorted(_all_link_samples(db))
+        return db, t, plane, keys
+
+    def _value(self, plane, key):
+        i, j = divmod(plane._key_to_flat[key], plane._v)
+        return float(np.asarray(plane.snapshot())[i, j])
+
+    def test_stale_link_halves_per_flush_past_horizon(self):
+        db, t, plane, keys = self._bound_plane(horizon=10.0)
+        key = keys[0]
+        plane.stage(key, 800.0)
+        plane.flush(now=0.0)
+        assert self._value(plane, key) == 800.0
+        plane.flush(now=5.0)  # inside the horizon: untouched
+        assert self._value(plane, key) == 800.0
+        assert plane.decay_count == 0
+        plane.flush(now=10.0)  # horizon crossed: halve
+        assert self._value(plane, key) == 400.0
+        plane.flush(now=11.0)  # still stale: halve again, toward zero
+        assert self._value(plane, key) == 200.0
+        assert plane.decay_count == 2
+
+    def test_fresh_sample_resets_the_clock(self):
+        db, t, plane, keys = self._bound_plane(horizon=10.0)
+        key = keys[0]
+        plane.stage(key, 800.0)
+        plane.flush(now=0.0)
+        plane.stage(key, 600.0)
+        plane.flush(now=9.0)  # fresh sample re-arms the horizon
+        assert self._value(plane, key) == 600.0
+        plane.flush(now=12.0)  # 3 s since last sample: not stale
+        assert self._value(plane, key) == 600.0
+        plane.flush(now=19.0)  # 10 s since last sample: decay
+        assert self._value(plane, key) == 300.0
+
+    def test_only_stale_links_decay(self):
+        db, t, plane, keys = self._bound_plane(horizon=10.0)
+        dead, live = keys[0], keys[1]
+        plane.stage(dead, 800.0)
+        plane.stage(live, 500.0)
+        plane.flush(now=0.0)
+        plane.stage(live, 500.0)
+        plane.flush(now=12.0)  # live refreshed; dead crossed the horizon
+        assert self._value(plane, dead) == 400.0
+        assert self._value(plane, live) == 500.0
+
+    def test_decay_publishes_a_new_epoch(self):
+        """Routing must see the decayed state: a decay-only flush (no
+        staged samples) still publishes, invalidating the base cache."""
+        db, t, plane, keys = self._bound_plane(horizon=10.0)
+        plane.stage(keys[0], 800.0)
+        plane.flush(now=0.0)
+        before = plane.epoch
+        plane.flush(now=20.0)
+        assert plane.epoch == before + 1
+
+    def test_horizon_zero_keeps_last_sample_semantics(self):
+        db, t, plane, keys = self._bound_plane(horizon=0.0)
+        plane.stage(keys[0], 800.0)
+        plane.flush(now=0.0)
+        plane.flush(now=1e9)
+        assert self._value(plane, keys[0]) == 800.0
+        assert plane.decay_count == 0
+        assert not plane._last_sample  # no tracking churn when disabled
+
+    def test_decay_is_bounded_for_permanently_dead_monitors(self):
+        """A monitor that never comes back costs a BOUNDED number of
+        decay scatters + epoch publishes: after _DECAY_ROUNDS_MAX
+        halvings the slot snaps to exact zero, the clock is dropped,
+        and further flushes neither decay nor publish."""
+        db, t, plane, keys = self._bound_plane(horizon=1.0)
+        plane.stage(keys[0], 8e9)
+        plane.flush(now=0.0)
+        for i in range(plane._DECAY_ROUNDS_MAX + 5):
+            plane.flush(now=2.0 + i)
+        assert self._value(plane, keys[0]) == 0.0  # exact zero, not denormal
+        assert plane.decay_count == plane._DECAY_ROUNDS_MAX
+        assert keys[0] not in plane._last_sample
+        epoch = plane.epoch
+        plane.flush(now=1e6)  # nothing stale left: no publish
+        assert plane.epoch == epoch
+        # a resurrected monitor re-arms the clock from scratch
+        plane.stage(keys[0], 4e9)
+        plane.flush(now=1e6 + 1)
+        assert self._value(plane, keys[0]) == np.float32(4e9)
+        plane.flush(now=1e6 + 3)
+        assert self._value(plane, keys[0]) == np.float32(2e9)
+
+    def test_dropped_key_stops_decaying(self):
+        """Utilization hygiene: a dead link's sample clock dies with it
+        (the slot itself is zeroed through the delta-log repair)."""
+        db, t, plane, keys = self._bound_plane(horizon=10.0)
+        plane.stage(keys[0], 800.0)
+        plane.flush(now=0.0)
+        plane.drop(keys[0])
+        assert keys[0] not in plane._last_sample
+        plane.flush(now=50.0)  # no stale set left: nothing to decay
+        assert plane.decay_count == 0
+
+
 class TestTraceBounds:
     def test_no_per_batch_size_recompile(self):
         """Varying sample-batch sizes ride the power-of-two bucket
