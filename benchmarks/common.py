@@ -8,6 +8,14 @@ import time
 
 import numpy as np
 
+# `benchmarks/run.py --metrics-dump` sets $SDNMPI_METRICS_DUMP for each
+# config subprocess; every config imports this module, so arming the
+# exit hook here gives each run a registry exposition next to its bench
+# JSON without per-config plumbing.
+from sdnmpi_tpu.api.telemetry import install_env_dump_hook
+
+install_env_dump_hook()
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)

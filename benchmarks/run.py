@@ -82,6 +82,7 @@ def run_suite(
     timeout_s: float | None = None,
     probe=probe_backend,
     suite_name: str = "BENCH_suite.json",
+    metrics_dump: bool = False,
 ) -> list[dict]:
     """Run ``configs`` (list of (name, cmd)); flush the suite file after
     each one; fail the remainder fast if the backend probe says the
@@ -119,10 +120,19 @@ def run_suite(
             continue
         print(f"== config {name}: {' '.join(cmd[1:])}", file=sys.stderr,
               flush=True)
+        env = None
+        if metrics_dump:
+            # each config subprocess dumps its own telemetry registry
+            # as a Prometheus-style exposition next to the bench JSON
+            # (benchmarks/common.py arms the exit hook off this var)
+            from sdnmpi_tpu.api.telemetry import DUMP_ENV
+
+            env = dict(os.environ)
+            env[DUMP_ENV] = str(root / f"BENCH_metrics_{name}.prom")
         try:
             proc = subprocess.run(
                 cmd, cwd=root, capture_output=True, text=True,
-                timeout=timeout_s,
+                timeout=timeout_s, env=env,
             )
         except subprocess.TimeoutExpired:
             emit({"config": name, "error": "timeout"})
@@ -220,10 +230,11 @@ def main() -> None:
     root = pathlib.Path(__file__).resolve().parent.parent
     args = sys.argv[1:]
     flags = {a for a in args if a.startswith("--")}
-    if unknown_flags := flags - {"--json-schema-check"}:
+    if unknown_flags := flags - {"--json-schema-check", "--metrics-dump"}:
         # a typo'd flag must not silently launch the full TPU suite
         sys.exit(f"unknown flag(s) {sorted(unknown_flags)}")
     schema_only = "--json-schema-check" in flags
+    metrics_dump = "--metrics-dump" in flags
     only = {a for a in args if not a.startswith("--")}
     known = {name for name, _ in CONFIGS}
     if unknown := only - known:
@@ -241,7 +252,7 @@ def main() -> None:
             print(e, file=sys.stderr)
         print(f"json-schema-check: {len(errors)} violation(s)")
         sys.exit(1 if errors else 0)
-    results = run_suite(CONFIGS, root, only)
+    results = run_suite(CONFIGS, root, only, metrics_dump=metrics_dump)
     failed = [r for r in results if "error" in r]
     # post-run gate: whatever just landed must also be well-formed
     errors = check_rows(results)

@@ -79,6 +79,12 @@ class RPCInterface:
             ev.EventCollectiveRemoved,
             lambda e: self._broadcast("remove_collective", e.cookie),
         )
+        # live telemetry feed: one update_telemetry notification per
+        # Monitor pass (EventStatsFlush), carrying the controller's
+        # registry snapshot — the same payload api/telemetry.py renders
+        # as the Prometheus text exposition (ISSUE 4)
+        if config.rpc_telemetry:
+            bus.subscribe(ev.EventStatsFlush, self._telemetry_flush)
 
     # -- client lifecycle -------------------------------------------------
 
@@ -101,6 +107,22 @@ class RPCInterface:
     def detach_client(self, client: RPCClient) -> None:
         if client in self.clients:
             self.clients.remove(client)
+
+    def _telemetry_flush(self, event: ev.EventStatsFlush) -> None:
+        """Riding the Monitor cadence: snapshot once, broadcast to every
+        client. No clients, no snapshot — the disabled path costs one
+        list check per Monitor pass."""
+        if not self.clients:
+            return
+        try:
+            snap = self.bus.request(ev.TelemetryRequest()).telemetry
+        except LookupError:
+            # minimal stacks without a Controller on the bus: fall back
+            # to the process-wide registry directly
+            from sdnmpi_tpu.api.telemetry import telemetry_snapshot
+
+            snap = telemetry_snapshot()
+        self._broadcast("update_telemetry", snap)
 
     # -- broadcasting -----------------------------------------------------
 

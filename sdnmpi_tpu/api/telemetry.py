@@ -1,0 +1,121 @@
+"""Text exposition of the telemetry plane (Prometheus scrape format).
+
+The RPC mirror's ``update_telemetry`` broadcast and this renderer read
+the SAME registry snapshot (utils/metrics.REGISTRY + the oracle stats
+summary), so the visualizer feed and scrape-style tooling can never
+disagree — one registry, two encodings.
+
+Entry points:
+
+- :func:`render` — Prometheus text format (0.0.4) of a snapshot;
+- :func:`telemetry_snapshot` — the shared JSON-safe snapshot payload
+  (registry + oracle latency summary);
+- :func:`dump` — write the exposition to a path ("-" = stdout), used
+  by ``python -m sdnmpi_tpu --metrics-dump`` and the bench suite's
+  ``--metrics-dump`` (each config subprocess dumps its own registry
+  next to the bench JSON via :func:`install_env_dump_hook`).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+#: env var the bench runner sets for config subprocesses: a path to
+#: dump the registry exposition to at interpreter exit
+DUMP_ENV = "SDNMPI_METRICS_DUMP"
+
+
+def telemetry_snapshot(registry=None, stats=None) -> dict:
+    """The one telemetry payload: registry snapshot plus the oracle
+    wall-time summary. Everything JSON-safe; the RPC broadcast ships it
+    verbatim and :func:`render` flattens it to text."""
+    if registry is None:
+        registry = REGISTRY
+    if stats is None:
+        from sdnmpi_tpu.utils.tracing import STATS
+
+        stats = STATS
+    snap = registry.snapshot()
+    snap["oracle"] = stats.summary()
+    return snap
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    return "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: an unescaped quote/backslash in
+    one label value would make the parser reject the ENTIRE scrape."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def render(snapshot: dict) -> str:
+    """Prometheus text exposition of a :func:`telemetry_snapshot` (or a
+    bare registry snapshot). Counter names already carrying a label
+    (``name{key=value}``) pass through with the label quoted."""
+    lines: list[str] = []
+    for name, value in snapshot.get("counters", {}).items():
+        if "{" in name:
+            # split on the FIRST '{' and drop only the final '}' — the
+            # label value itself may contain braces
+            base, label = name.split("{", 1)
+            if label.endswith("}"):
+                label = label[:-1]
+            key, _, val = label.partition("=")
+            lines.append(
+                f'{_sanitize(base)}{{{key}="{_escape_label(val)}"}} {value}'
+            )
+        else:
+            lines.append(f"{_sanitize(name)} {value}")
+    for name, value in snapshot.get("gauges", {}).items():
+        lines.append(f"{_sanitize(name)} {value}")
+    for name, h in snapshot.get("histograms", {}).items():
+        name = _sanitize(name)
+        cumulative = 0
+        for bound, count in zip(h["buckets"], h["counts"]):
+            cumulative += count
+            lines.append(f'{name}_bucket{{le="{bound}"}} {cumulative}')
+        cumulative += h["counts"][-1]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{name}_sum {h['sum']}")
+        lines.append(f"{name}_count {h['count']}")
+    # oracle latency summary flattens to gauges (count/mean/p50/p99/max
+    # per op) so scrape tooling sees route-compute latency too
+    for op, s in snapshot.get("oracle", {}).items():
+        base = _sanitize(f"oracle_{op}")
+        for key, value in s.items():
+            lines.append(f"{base}_{key} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def dump(path: str = "-", snapshot: dict | None = None) -> str:
+    """Render the current telemetry and write it to ``path`` ("-" =
+    stdout). Returns the rendered text."""
+    text = render(telemetry_snapshot() if snapshot is None else snapshot)
+    if path == "-":
+        sys.stdout.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def install_env_dump_hook() -> bool:
+    """Arm an interpreter-exit dump to ``$SDNMPI_METRICS_DUMP`` when the
+    env var is set (the bench runner's --metrics-dump plumbing: each
+    config subprocess dumps its own registry next to its bench JSON).
+    Returns True when armed."""
+    import atexit
+    import os
+
+    path = os.environ.get(DUMP_ENV)
+    if not path:
+        return False
+    atexit.register(lambda: dump(path))
+    return True

@@ -202,6 +202,19 @@ class Config:
     #: jax.profiler trace output dir ("" = disabled); wraps the run in a
     #: TensorBoard-compatible device profile
     profile_dir: str = ""
+    #: rotate the JSONL event log when it reaches this many bytes: the
+    #: full file moves to ``<path>.1`` (replacing the previous rotation)
+    #: and a fresh one opens, bounding a long-running controller's event
+    #: history to ~2x this size. 0 = never rotate (grow unboundedly,
+    #: the pre-rotation behavior).
+    event_log_max_bytes: int = 0
+    #: broadcast a ``update_telemetry`` JSON-RPC notification (the
+    #: metrics-registry snapshot + oracle latency summary) to attached
+    #: RPC clients once per Monitor pass (EventStatsFlush) — the live
+    #: feed twin of the Prometheus text exposition (api/telemetry.py);
+    #: both read the same registry. False silences the feed (snapshot
+    #: requests still work).
+    rpc_telemetry: bool = True
 
 
 DEFAULT_CONFIG = Config()

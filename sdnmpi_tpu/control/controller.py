@@ -20,6 +20,8 @@ from sdnmpi_tpu.control.topology_manager import TopologyManager
 
 
 class Controller:
+    name = "Controller"
+
     def __init__(
         self,
         southbound,
@@ -28,6 +30,16 @@ class Controller:
         self.config = config
         self.bus = EventBus()
         self.southbound = southbound
+        # telemetry snapshot seam: the RPC mirror (and anything else on
+        # the bus) reads the process-wide registry through the
+        # composition root, so tests can interpose and the reply always
+        # carries the controller's own view
+        from sdnmpi_tpu.control import events as ev
+
+        self.bus.provide(
+            ev.TelemetryRequest,
+            lambda req: ev.TelemetryReply(self.telemetry()),
+        )
 
         # Subscription order fixes packet-in handling order; the reference's
         # equivalent order is Ryu's app instantiation order (SURVEY §3.1).
@@ -70,9 +82,25 @@ class Controller:
         if config.event_log:
             from sdnmpi_tpu.utils.event_log import EventLogger
 
-            self.event_logger = EventLogger(config.event_log)
+            self.event_logger = EventLogger(
+                config.event_log, max_bytes=config.event_log_max_bytes
+            )
             self.bus.tap(self.event_logger)
 
     def attach(self) -> None:
         """Connect the southbound fabric and replay discovery."""
         self.southbound.connect(self.bus)
+
+    def telemetry(self) -> dict:
+        """One snapshot of the control-plane telemetry: the process-wide
+        metrics registry (counters/gauges/histograms, the jit-trace
+        family) plus the oracle wall-time summary. The RPC mirror
+        broadcasts exactly this dict as ``update_telemetry`` and the
+        Prometheus exposition (api/telemetry.py) renders exactly this
+        dict — one registry, two encodings, no chance of drift."""
+        from sdnmpi_tpu.api.telemetry import telemetry_snapshot
+
+        # the event log's own figures (event_log_events_total,
+        # event_log_rotations_total) already live in the registry —
+        # no hand-injected duplicates to reconcile
+        return telemetry_snapshot()

@@ -354,6 +354,26 @@ class CurrentCollectivesReply(Reply):
     collectives: Any  # core.collective_table.CollectiveTable
 
 
+# -- telemetry ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TelemetryRequest(Request):
+    """Snapshot of the control-plane telemetry registry (counters,
+    gauges, histograms, oracle latency summary). Provided by the
+    Controller; the RPC mirror requests one per Monitor pass
+    (EventStatsFlush) and broadcasts it as ``update_telemetry`` so the
+    visualizer and the Prometheus text exposition (api/telemetry.py)
+    always report the same values from the same registry."""
+
+    dst = "Controller"
+
+
+@dataclasses.dataclass
+class TelemetryReply(Reply):
+    telemetry: dict
+
+
 # -- monitor --------------------------------------------------------------
 
 

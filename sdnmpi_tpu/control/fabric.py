@@ -31,8 +31,17 @@ from sdnmpi_tpu.control.events import (
 )
 from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
 from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger(__name__)
+
+# wire-mode twin of the real southbound's batched-encode volume counter
+# (registered idempotently — whichever module imports first wins the
+# help string, the instrument is shared)
+_m_encode_bytes = REGISTRY.counter(
+    "southbound_encode_bytes_total",
+    "bytes produced by batched FlowMod window encodes",
+)
 
 _MAX_HOPS = 64  # forwarding-loop guard for the simulation
 
@@ -574,6 +583,9 @@ class Fabric:
                 batch, xid_base=self._xid + 1
             )
             self._xid += len(batch)
+            # same instrument the real southbound records, so wire-mode
+            # sims exercise the telemetry plane end to end
+            _m_encode_bytes.inc(len(blob))
             for i in range(len(dpids)):
                 sw = self.switches.get(int(dpids[i]))
                 if sw is None:

@@ -26,8 +26,17 @@ from typing import Optional
 from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
 from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
+from sdnmpi_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("Monitor")
+
+_m_passes = REGISTRY.counter(
+    "monitor_passes_total",
+    "completed port-stats sampling passes (the telemetry feed cadence)",
+)
+_m_samples = REGISTRY.counter(
+    "monitor_port_samples_total", "per-port throughput samples published"
+)
 
 
 @dataclasses.dataclass
@@ -75,6 +84,7 @@ class Monitor:
         utilization plane's scatter cadence)."""
         for dpid in sorted(self.datapaths):
             self._poll_one(dpid, time.time() if now is None else now)
+        _m_passes.inc()
         self.bus.publish(ev.EventStatsFlush())
 
     def _poll_one(self, dpid: int, now: float) -> None:
@@ -113,6 +123,7 @@ class Monitor:
                 tx_pps,
                 tx_bps,
             )
+            _m_samples.inc()
             self.bus.publish(
                 ev.EventPortStats(dpid, stat.port_no, rx_pps, rx_bps, tx_pps, tx_bps)
             )
@@ -146,6 +157,7 @@ class Monitor:
                 if (i + 1) % self.POLL_SLICE == 0:
                     await asyncio.sleep(0)
             # one vectorized utilization flush per pass (see poll())
+            _m_passes.inc()
             self.bus.publish(ev.EventStatsFlush())
             elapsed = loop.time() - started
             await asyncio.sleep(

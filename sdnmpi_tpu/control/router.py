@@ -31,15 +31,90 @@ from sdnmpi_tpu.core.switch_fdb import SwitchFDB
 from sdnmpi_tpu.protocol import openflow as of
 from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac, is_sdn_mpi_addr
 from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
+from sdnmpi_tpu.utils.metrics import LATENCY_BUCKETS_S, REGISTRY, SIZE_BUCKETS
+from sdnmpi_tpu.utils.tracing import NULL_SPAN, start_span
 
 log = logging.getLogger("Router")
+
+# -- pipeline telemetry (ISSUE 4): every stage of the route->install
+# pipeline records into the process-wide registry; the RPC mirror and
+# the Prometheus exposition read the same instruments.
+_m_packet_ins = REGISTRY.counter(
+    "router_packet_ins_total", "unicast/MPI packet-ins dispatched to routing"
+)
+_m_window_occupancy = REGISTRY.histogram(
+    "coalescer_window_occupancy", SIZE_BUCKETS,
+    "parked route lookups per flushed coalescer window",
+)
+_m_window_age = REGISTRY.histogram(
+    "coalescer_window_age_seconds", LATENCY_BUCKETS_S,
+    "park-to-window-cut age of each window's oldest member",
+)
+_m_queue_depth = REGISTRY.gauge(
+    "coalescer_queue_depth", "route lookups parked right now"
+)
+_m_windows = REGISTRY.counter(
+    "pipeline_windows_total", "route windows resolved (batched or serial)"
+)
+_m_inflight = REGISTRY.gauge(
+    "pipeline_inflight_windows", "dispatched-but-unreaped route windows"
+)
+_m_reap_s = REGISTRY.histogram(
+    "pipeline_reap_seconds", LATENCY_BUCKETS_S,
+    "host blocked in RouteWindow.reap (device wait + decode)",
+)
+_m_install_s = REGISTRY.histogram(
+    "pipeline_install_seconds", LATENCY_BUCKETS_S,
+    "window FlowMod materialization + batched install",
+)
+_m_e2e_s = REGISTRY.histogram(
+    "install_e2e_seconds", LATENCY_BUCKETS_S,
+    "coalescer flush end-to-end (first dispatch -> last install) — the "
+    "live twin of bench config 10's install_e2e_ms",
+)
+_m_overlap_gain = REGISTRY.gauge(
+    "pipeline_overlap_gain",
+    "serial-equivalent wall / end-to-end wall of the last flush burst "
+    "(>1 means device compute overlapped host decode+install — the "
+    "live twin of bench config 10's overlap_gain). The serial "
+    "equivalent counts each window's in-flight interval (dispatch "
+    "return -> reap start) as device time a serial pass would have "
+    "waited out, so it is an upper-bound estimate: exact when the "
+    "device is busy the whole interval, optimistic when it finished "
+    "early",
+)
+_m_routed = REGISTRY.counter(
+    "router_routes_resolved_total", "route lookups that found a path"
+)
+_m_unroutable = REGISTRY.counter(
+    "router_routes_unroutable_total", "route lookups with no path"
+)
+_m_flows_installed = REGISTRY.counter(
+    "router_flows_installed_total", "switch-level flow entries installed"
+)
+_m_flows_deleted = REGISTRY.counter(
+    "router_flows_deleted_total", "switch-level flow entries torn down"
+)
+_m_teardown_batches = REGISTRY.counter(
+    "router_teardown_batches_total",
+    "revalidation/exit teardown bursts sent as batched OFPFC_DELETEs",
+)
+_m_revalidations = REGISTRY.counter(
+    "router_revalidations_total", "flow revalidation passes that ran"
+)
+_m_revalidations_skipped = REGISTRY.counter(
+    "router_revalidations_skipped_total",
+    "revalidation passes skipped by the epoch gate",
+)
 
 
 @dataclasses.dataclass
 class _PendingRoute:
     """One packet-in's route lookup parked in the coalescer: the match
     pair, the true destination (MPI virtual-MAC flows), and everything
-    needed to finish the packet's handling after the batched reply."""
+    needed to finish the packet's handling after the batched reply.
+    ``span`` is the packet-in's root trace span (NULL_SPAN when tracing
+    is off); ``park`` times the coalescer wait."""
 
     src: str
     dst: str  # match destination (virtual MAC for MPI flows)
@@ -48,6 +123,11 @@ class _PendingRoute:
     in_port: int
     pkt: of.Packet
     buffer_id: int
+    span: object = NULL_SPAN
+    park: object = NULL_SPAN
+    #: monotonic park time — each flushed window's age histogram sample
+    #: is measured from ITS oldest member, not the queue's first park
+    t_parked: float = 0.0
 
 
 class Router:
@@ -126,7 +206,67 @@ class Router:
             priority=self.config.priority_default,
             command=of.OFPFC_DELETE,
         )
+        _m_flows_deleted.inc()
         self.southbound.flow_mod(dpid, mod)
+
+    def _del_flows_window(self, rows: list[tuple[int, str, str]]) -> None:
+        """Tear down a burst of (dpid, src, dst) exact matches through
+        the PR-3 window installer: the whole burst materializes as ONE
+        ``OFPFC_DELETE`` :class:`~sdnmpi_tpu.protocol.openflow.FlowModBatch`
+        and serializes in one batched wire encode
+        (``encode_flow_mods_spans`` — the encoder always supported the
+        command; this is the first caller), with each switch receiving
+        its contiguous byte span. Revalidation after a link flap and
+        rank-exit teardowns are delete *storms* — per-mod scalar
+        encodes cost what the PR-3 install batching already eliminated
+        on the add side. Dead datapaths are skipped (same rule as the
+        scalar leg); ``pipelined_install=False`` or a batchless
+        southbound falls back to scalar ``_del_flow`` per row — the
+        differential escape hatch, byte-identical on the wire."""
+        live = [r for r in rows if r[0] in self.dps]
+        if not live:
+            return
+        if (
+            not self.config.pipelined_install
+            or not hasattr(self.southbound, "flow_mods_batch")
+        ):
+            for dpid, src, dst in live:
+                self._del_flow(dpid, src, dst)
+            return
+        import numpy as np
+
+        from sdnmpi_tpu.utils.mac import macs_to_ints
+
+        kd = np.array([r[0] for r in live], np.int64)
+        order = np.argsort(kd, kind="stable")
+        kd = kd[order]
+        burst = of.FlowModBatch(
+            src=macs_to_ints([r[1] for r in live])[order],
+            dst=macs_to_ints([r[2] for r in live])[order],
+            out_port=np.zeros(len(live), np.int32),  # DELETE: no actions
+            rewrite=None,
+            priority=self.config.priority_default,
+            command=of.OFPFC_DELETE,
+        )
+        _m_flows_deleted.inc(len(live))
+        _m_teardown_batches.inc()
+        window_send = getattr(self.southbound, "flow_mods_window", None)
+        if window_send is not None:
+            window_send(kd, burst)
+        else:
+            from sdnmpi_tpu.utils.arrays import group_spans
+
+            for lo, hi in group_spans(kd):
+                self.southbound.flow_mods_batch(
+                    int(kd[lo]), of.FlowModBatch(
+                        src=burst.src[lo:hi],
+                        dst=burst.dst[lo:hi],
+                        out_port=burst.out_port[lo:hi],
+                        rewrite=None,
+                        priority=burst.priority,
+                        command=of.OFPFC_DELETE,
+                    )
+                )
 
     def _add_flows_for_path(
         self,
@@ -145,6 +285,7 @@ class Router:
                 # datapath returns
                 continue
             self.fdb.update(dpid, src, dst, out_port)
+            _m_flows_installed.inc()
             self.bus.publish(ev.EventFDBUpdate(dpid, src, dst, out_port))
 
             if true_dst and idx == len(fdb) - 1:
@@ -195,14 +336,22 @@ class Router:
 
         log.info("Packet in at %s (%s) %s -> %s", event.dpid, event.in_port, src, dst)
 
+        _m_packet_ins.inc()
+        sp = start_span(
+            "packet_in", dpid=event.dpid, in_port=event.in_port,
+            src=src, dst=dst,
+        )
         if self.coalesce:
-            return self._enqueue_route(src, dst, None, event)
+            return self._enqueue_route(src, dst, None, event, span=sp)
         fdb = self.bus.request(ev.FindRouteRequest(src, dst)).fdb
         if fdb:
+            _m_routed.inc()
             self._add_flows_for_path(fdb, src, dst)
             self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
         else:
+            _m_unroutable.inc()
             self.bus.request(ev.BroadcastRequest(pkt, event.dpid, event.in_port))
+        sp.end(routable=bool(fdb))
 
     # -- MPI packets (reference: router.py:166-195) -----------------------
 
@@ -220,13 +369,24 @@ class Router:
         if not true_dst:
             return  # unresolved rank -> drop (reference: router.py:186-187)
 
+        _m_packet_ins.inc()
+        sp = start_span(
+            "packet_in", dpid=event.dpid, in_port=event.in_port,
+            src=pkt.eth_src, dst=pkt.eth_dst, mpi=True,
+        )
         if self.coalesce:
-            self._enqueue_route(pkt.eth_src, pkt.eth_dst, true_dst, event)
+            self._enqueue_route(
+                pkt.eth_src, pkt.eth_dst, true_dst, event, span=sp
+            )
         else:
             fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
             if fdb:
+                _m_routed.inc()
                 self._add_flows_for_path(fdb, pkt.eth_src, pkt.eth_dst, true_dst)
                 self._send_packet_out(fdb, event.dpid, pkt, event.buffer_id)
+            else:
+                _m_unroutable.inc()
+            sp.end(routable=bool(fdb))
 
         if self.config.proactive_collectives and vmac.coll_type != CollectiveType.P2P:
             self._install_collective(vmac)
@@ -234,7 +394,8 @@ class Router:
     # -- route-request coalescing (no reference equivalent) ---------------
 
     def _enqueue_route(
-        self, src: str, dst: str, true_dst: str | None, event: ev.EventPacketIn
+        self, src: str, dst: str, true_dst: str | None,
+        event: ev.EventPacketIn, span=NULL_SPAN,
     ) -> None:
         """Park one packet-in's route lookup for batched resolution.
 
@@ -244,12 +405,15 @@ class Router:
         (Fabric.on_idle -> :meth:`flush_routes`) bounds the wait: a
         burst is always resolved before control returns to the caller
         that injected it, so coalescing never strands a packet."""
+        now = time.monotonic()
         if not self._pending:
-            self._pending_t0 = time.monotonic()
+            self._pending_t0 = now
         self._pending.append(_PendingRoute(
             src, dst, true_dst, event.dpid, event.in_port, event.pkt,
-            event.buffer_id,
+            event.buffer_id, span=span, park=span.child("coalesce_park"),
+            t_parked=now,
         ))
+        _m_queue_depth.set(len(self._pending))
         if not self._flushing and (
             len(self._pending) >= self.config.coalesce_max_batch
             or time.monotonic() - self._pending_t0
@@ -272,40 +436,112 @@ class Router:
         while the host decodes, materializes, and installs k — the
         device never idles between windows of a burst. Install order is
         preserved (k always installs before k+1 is reaped)."""
-        if self._flushing:
+        if self._flushing or not self._pending:
+            # idle edges fire constantly; an empty flush must not
+            # observe a meaningless e2e sample
             return
         self._flushing = True
+        t_flush0 = time.perf_counter()
+        stage_wall = 0.0  # dispatch + reap + install walls
+        hidden_wall = 0.0  # in-flight device intervals the host overlapped
+
+        def _reap_timed(batch, handle, wsp, t_dispatched):
+            """Reap window ``handle`` (timed, spanned) and finish its
+            batch. The interval between the window's dispatch return
+            and this reap is device compute the host overlapped with
+            other work — a serial pass would have waited it out, so it
+            feeds the overlap-gain numerator."""
+            nonlocal stage_wall, hidden_wall
+            t0 = time.perf_counter()
+            hidden_wall += t0 - t_dispatched
+            rsp = wsp.child("reap")
+            try:
+                wr = handle.reap()
+            finally:
+                # a raising reap (device error surfacing through the
+                # window) must not leave the in-flight gauge pinned or
+                # the spans open — the controller outlives the window
+                rsp.end()
+                dt = time.perf_counter() - t0
+                _m_reap_s.observe(dt)
+                _m_inflight.dec()
+            t0 = time.perf_counter()
+            try:
+                self._finish_batch(batch, wr, wsp)
+            finally:
+                wsp.end()
+                stage_wall += dt + (time.perf_counter() - t0)
+
         try:
-            prev: tuple[list[_PendingRoute], object] | None = None
+            prev: tuple | None = None  # (batch, window, wsp, t_dispatched)
             while self._pending or prev is not None:
                 batch = self._pending[: self.config.coalesce_max_batch]
                 del self._pending[: len(batch)]
+                _m_queue_depth.set(len(self._pending))
                 window = None
+                wsp = NULL_SPAN
                 if batch:
+                    _m_window_occupancy.observe(len(batch))
+                    # age of THIS window's oldest member (not the whole
+                    # queue's t0: later windows of one flush parked later)
+                    _m_window_age.observe(
+                        time.monotonic() - batch[0].t_parked
+                    )
+                    _m_windows.inc()
+                    # window span: tree-parented to the first parked
+                    # packet; the rest of the fan-in is recorded as
+                    # span_link records (many packet-ins -> one window)
+                    wsp = batch[0].span.child(
+                        "route_window", n_pairs=len(batch)
+                    )
+                    for p in batch:
+                        p.park.end()
+                        if p is not batch[0]:
+                            wsp.link(p.span)
                     pairs = [(p.src, p.true_dst or p.dst) for p in batch]
+                    dsp = wsp.child("dispatch")
+                    t0 = time.perf_counter()
                     window = self._dispatch_window(pairs)
+                    t_dispatched = time.perf_counter()
+                    stage_wall += t_dispatched - t0
+                    dsp.end(split_phase=window is not None)
                     if window is None:
                         # no split-phase provider on this bus (or
                         # pipelining off): serial resolve-then-install
                         if prev is not None:
-                            self._finish_batch(prev[0], prev[1].reap())
+                            _reap_timed(*prev)
                             prev = None
                         reply = self.bus.request(
                             ev.FindRoutesBatchRequest(pairs)
                         )
                         from sdnmpi_tpu.oracle.batch import WindowRoutes
 
+                        t0 = time.perf_counter()
                         self._finish_batch(
-                            batch, WindowRoutes.from_fdbs(reply.fdbs)
+                            batch, WindowRoutes.from_fdbs(reply.fdbs), wsp
                         )
+                        wsp.end()
+                        stage_wall += time.perf_counter() - t0
                         continue
+                    _m_inflight.inc()
                 # window k+1 is now in flight: reap + install window k
                 # while the device chews on k+1
                 if prev is not None:
-                    self._finish_batch(prev[0], prev[1].reap())
-                prev = (batch, window) if batch else None
+                    _reap_timed(*prev)
+                prev = (
+                    (batch, window, wsp, t_dispatched) if batch else None
+                )
         finally:
             self._flushing = False
+            e2e = time.perf_counter() - t_flush0
+            _m_e2e_s.observe(e2e)
+            if e2e > 0:
+                # live twin of bench config 10's overlap_gain: the
+                # serial-equivalent wall (host stages + the in-flight
+                # device intervals a serial pass would have waited out)
+                # over the achieved end-to-end wall. ~1.0 = serial;
+                # >1 = device compute overlapped host decode+install
+                _m_overlap_gain.set((stage_wall + hidden_wall) / e2e)
 
     def _dispatch_window(self, pairs, policy: str = "shortest"):
         """Dispatch one window through the split-phase oracle API, or
@@ -321,17 +557,26 @@ class Router:
         except LookupError:
             return None
 
-    def _finish_batch(self, batch: list[_PendingRoute], wr) -> None:
+    def _finish_batch(
+        self, batch: list[_PendingRoute], wr, wsp=NULL_SPAN
+    ) -> None:
         """Install one reaped window and finish its parked packets:
         vectorized FlowMod materialization + batched install for the
         whole window, then per-packet packet-out / broadcast fallback
         (the per-packet leg is inherently scalar — one PacketOut each)."""
         import numpy as np
 
+        t0 = time.perf_counter()
+        isp = wsp.child("install")
         routable = self._install_window(
-            [(p.src, p.dst, p.true_dst) for p in batch], wr
+            [(p.src, p.dst, p.true_dst) for p in batch], wr, parent=isp
         )
+        isp.end(n_routable=int(np.count_nonzero(routable)))
+        _m_install_s.observe(time.perf_counter() - t0)
+        _m_routed.inc(int(np.count_nonzero(routable)))
+        _m_unroutable.inc(len(batch) - int(np.count_nonzero(routable)))
         for k, p in enumerate(batch):
+            p.span.end(routable=bool(routable[k]))
             if routable[k]:
                 n = int(wr.hop_len[k])
                 hops = wr.hop_dpid[k, :n]
@@ -354,7 +599,7 @@ class Router:
                     ev.BroadcastRequest(p.pkt, p.dpid, p.in_port)
                 )
 
-    def _install_window(self, entries, wr):
+    def _install_window(self, entries, wr, parent=NULL_SPAN):
         """Install a whole window's flows from its WindowRoutes arrays.
 
         ``entries`` is ``[(src, dst, true_dst), ...]`` row-aligned with
@@ -437,6 +682,11 @@ class Router:
                 idle_timeout=self.config.flow_idle_timeout,
                 hard_timeout=self.config.flow_hard_timeout,
             )
+            _m_flows_installed.inc(len(kd))
+            ssp = parent.child(
+                "southbound_send", n_rows=len(kd),
+                n_switches=int(np.count_nonzero(np.diff(kd)) + 1),
+            )
             window_send = getattr(self.southbound, "flow_mods_window", None)
             if window_send is not None:
                 # one batched encode for the whole window; each switch
@@ -457,6 +707,7 @@ class Router:
                             hard_timeout=burst.hard_timeout,
                         )
                     )
+            ssp.end()
         return routable
 
     def _install_collective(self, vmac: VirtualMac) -> None:
@@ -811,7 +1062,9 @@ class Router:
         every flow in the fabric."""
         dirty = self._reval_dirty_set()
         if dirty is not None and not dirty:
+            _m_revalidations_skipped.inc()
             return  # nothing advanced since the last pass
+        _m_revalidations.inc()
         for install in self.collectives:
             self._remove_collective(install)
             self._reinstall_collective(install)
@@ -827,6 +1080,7 @@ class Router:
         if not flows:
             return
 
+        doomed: list[tuple[int, str, str]] = []  # batched teardown burst
         resolved: list[tuple[tuple[str, str], str]] = []
         for src, dst in flows:
             effective = self._effective_dst(dst)
@@ -835,8 +1089,7 @@ class Router:
                 for dpid, _ in flows[(src, dst)].items():
                     self.fdb.remove(dpid, src, dst)
                     self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
-                    if dpid in self.dps:
-                        self._del_flow(dpid, src, dst)
+                    doomed.append((dpid, src, dst))
                 continue
             resolved.append(((src, dst), effective))
 
@@ -844,6 +1097,7 @@ class Router:
             ev.FindRoutesBatchRequest([(src, eff) for (src, _), eff in resolved])
         ).fdbs
 
+        reinstall: list[tuple[list, str, str, str | None]] = []
         for ((src, dst), effective), new_fdb in zip(resolved, fdbs):
             installed = flows[(src, dst)]
             new_hops = dict(new_fdb)
@@ -851,11 +1105,17 @@ class Router:
                 if new_hops.get(dpid) != port:
                     self.fdb.remove(dpid, src, dst)
                     self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
-                    if dpid in self.dps:
-                        self._del_flow(dpid, src, dst)
+                    doomed.append((dpid, src, dst))
             if new_fdb:
                 true_dst = effective if is_sdn_mpi_addr(dst) else None
-                self._add_flows_for_path(new_fdb, src, dst, true_dst)
+                reinstall.append((new_fdb, src, dst, true_dst))
+        # deletes flush as ONE batched OFPFC_DELETE window BEFORE any
+        # reinstall: a rerouted pair's new flow shares the old one's
+        # (src, dst) match, so a delete landing after the install would
+        # wipe the fresh entry too
+        self._del_flows_window(doomed)
+        for new_fdb, src, dst, true_dst in reinstall:
+            self._add_flows_for_path(new_fdb, src, dst, true_dst)
 
     def _reinstall_collective(self, install: CollectiveInstall) -> None:
         """Re-route a previously installed collective against the current
@@ -893,8 +1153,8 @@ class Router:
         for dpid, src, dst in doomed:
             self.fdb.remove(dpid, src, dst)
             self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
-            if dpid in self.dps:
-                self._del_flow(dpid, src, dst)
+        # one batched OFPFC_DELETE window for the whole rank exit
+        self._del_flows_window(doomed)
 
     def reinstall_pairs(self, pairs: list[tuple[str, str]]) -> None:
         """Re-route and install flows for (src, dst) match pairs — used by

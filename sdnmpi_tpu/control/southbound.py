@@ -52,8 +52,36 @@ from sdnmpi_tpu.control.events import (
 from sdnmpi_tpu.core.topology_db import Port, Switch
 from sdnmpi_tpu.protocol import ofwire
 from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.utils.metrics import REGISTRY, SIZE_BUCKETS
 
 log = logging.getLogger("OFSouthbound")
+
+# queued/dropped verdicts of _send plus the batched-install wire volume
+# (ISSUE 4): the registry the RPC telemetry feed and the text
+# exposition both read.
+_m_sends = REGISTRY.counter(
+    "southbound_sends_total", "payloads queued to a datapath transport"
+)
+_m_drops = REGISTRY.counter(
+    "southbound_drops_total",
+    "payloads NOT queued (unknown peer or stalled-peer cut)",
+)
+_m_stall_cuts = REGISTRY.counter(
+    "southbound_stall_cuts_total",
+    "datapaths disconnected for exceeding the write-buffer cap",
+)
+_m_encode_bytes = REGISTRY.counter(
+    "southbound_encode_bytes_total",
+    "bytes produced by batched FlowMod window encodes",
+)
+_m_window_bytes = REGISTRY.histogram(
+    "southbound_window_bytes", SIZE_BUCKETS,
+    "batched encode size per FlowMod window",
+)
+_m_slices = REGISTRY.counter(
+    "southbound_install_slices_total",
+    "install_highwater byte slices written by batched installs",
+)
 
 OFP_TCP_PORT = 6633
 
@@ -306,6 +334,7 @@ class OFSouthbound:
         w = self._writers.get(dpid)
         if w is None:  # datapath died between event and send
             log.debug("send to unknown dpid %s dropped", dpid)
+            _m_drops.inc()
             return False
         if w.transport.get_write_buffer_size() > self.MAX_WRITE_BUFFER:
             log.warning(
@@ -316,8 +345,11 @@ class OFSouthbound:
             # stalled peer will never read, so connection_lost — and the
             # reader loop's datapath-down publication — would never fire
             w.transport.abort()
+            _m_drops.inc()
+            _m_stall_cuts.inc()
             return False
         w.write(payload)  # drained by the connection's event loop
+        _m_sends.inc()
         return True
 
     def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
@@ -360,6 +392,8 @@ class OFSouthbound:
             batch, xid_base=self._xid + 1
         )
         self._xid += n
+        _m_encode_bytes.inc(len(blob))
+        _m_window_bytes.observe(len(blob))
         step = max(1, int(self.install_highwater))
         for lo, hi in group_spans(dpids):
             dpid = int(dpids[lo])
@@ -369,6 +403,7 @@ class OFSouthbound:
                     # peer unknown or cut for stalling: drop the rest
                     # of THIS switch's burst (other switches continue)
                     break
+                _m_slices.inc()
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
         self._send(dpid, ofwire.encode_packet_out(out, xid=self._next_xid()))

@@ -34,6 +34,12 @@ from typing import Any, Optional
 
 from sdnmpi_tpu.protocol.openflow import OFPP_LOCAL
 from sdnmpi_tpu.utils.mac import mac_to_int
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+_m_log_breaks = REGISTRY.counter(
+    "topology_delta_log_breaks_total",
+    "delta-log breaks (structural mutations forcing full recomputes)",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +156,9 @@ class TopologyDB:
     def _break_deltas(self) -> None:
         self._delta_log.clear()
         self._delta_floor = self._version
+        # structural mutation the repair path cannot express: every
+        # oracle/utilplane consumer falls back to its full path
+        _m_log_breaks.inc()
 
     def add_host(self, host: Any) -> None:
         self.hosts[host.mac] = host

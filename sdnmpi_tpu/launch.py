@@ -123,6 +123,7 @@ def config_from_args(args) -> Config:
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
         event_log=args.event_log or "",
+        event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
     )
 
 
@@ -218,6 +219,16 @@ async def amain(args) -> None:
         summary = STATS.summary()
         if summary:
             log.info("oracle timing summary: %s", summary)
+        metrics_dump = getattr(args, "metrics_dump", None)
+        if metrics_dump:
+            # Prometheus-style text exposition of the telemetry
+            # registry ("-" = stdout) — same snapshot the RPC mirror's
+            # update_telemetry feed broadcast live
+            from sdnmpi_tpu.api.telemetry import dump
+
+            dump(metrics_dump, snapshot=controller.telemetry())
+            if metrics_dump != "-":
+                log.info("metrics exposition written to %s", metrics_dump)
         if args.checkpoint:
             from sdnmpi_tpu.api.snapshot import save_checkpoint
 
@@ -297,6 +308,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--event-log",
         help="JSONL control-plane event log (every bus event, one line)",
+    )
+    parser.add_argument(
+        "--event-log-max-bytes", type=int, default=0,
+        help="rotate the event log to <path>.1 at this size (0 = never)",
+    )
+    parser.add_argument(
+        "--metrics-dump", metavar="PATH",
+        help="write the telemetry registry as a Prometheus-style text "
+        "exposition on shutdown ('-' = stdout)",
     )
     parser.add_argument("--profile-dir", help="jax.profiler trace output dir")
     parser.add_argument("--demo", action="store_true", help="generate demo MPI traffic")

@@ -20,7 +20,18 @@ import numpy as np
 
 from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
 from sdnmpi_tpu.oracle.paths import batch_fdb, batch_paths
+from sdnmpi_tpu.utils.metrics import REGISTRY
 from sdnmpi_tpu.utils.tracing import STATS
+
+# repair-vs-recompute decisions of the cached-APSP maintenance path
+# (ISSUE 4): the per-instance repair_count/full_refresh_count stay the
+# test/bench contract; these registry twins feed the telemetry plane
+_m_repairs = REGISTRY.counter(
+    "oracle_repairs_total", "link deltas absorbed by in-place APSP repair"
+)
+_m_full_refreshes = REGISTRY.counter(
+    "oracle_full_refreshes_total", "full tensorize + APSP recomputes"
+)
 
 
 @jax.jit
@@ -367,6 +378,7 @@ class RouteOracle:
                 self._endpoint_memo = {}
             self._version = db.version
             self.repair_count += n_edges
+            _m_repairs.inc(n_edges)
         return True
 
     def refresh(self, db: "TopologyDB") -> TopoTensors:
@@ -412,6 +424,7 @@ class RouteOracle:
                 self._endpoint_memo = {}
                 self._version = db.version
                 self.full_refresh_count += 1
+                _m_full_refreshes.inc()
         return self._tensors
 
     @property

@@ -60,7 +60,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from sdnmpi_tpu.kernels.tiling import bucket_pad
+from sdnmpi_tpu.utils.metrics import REGISTRY
 from sdnmpi_tpu.utils.tracing import count_trace
+
+# per-instance counters (rebuild_count etc.) stay the test/bench
+# contract; these registry twins feed the live telemetry plane
+_m_epoch = REGISTRY.gauge(
+    "utilplane_epoch", "published epoch of the device utilization plane"
+)
+_m_flushes = REGISTRY.counter(
+    "utilplane_flushes_total", "staged-sample scatter flushes"
+)
+_m_decays = REGISTRY.counter(
+    "utilplane_decays_total", "stale-horizon slot decays (halvings + clears)"
+)
+_m_repairs = REGISTRY.counter(
+    "utilplane_repairs_total", "link slots repaired through the delta log"
+)
+_m_rebuilds = REGISTRY.counter(
+    "utilplane_rebuilds_total", "structural index-map rebuilds"
+)
 
 
 # -- jitted kernels --------------------------------------------------------
@@ -246,6 +265,7 @@ class UtilPlane:
                     np.float32(self.ewma_alpha),
                 )
                 self.flush_count += 1
+                _m_flushes.inc()
                 changed = True
         if horizon > 0 and self._last_sample:
             halve: list[int] = []
@@ -278,6 +298,7 @@ class UtilPlane:
                 self._live = _clear_slots(self._live, idx_p)
             if halve or clear:
                 self.decay_count += len(halve) + len(clear)
+                _m_decays.inc(len(halve) + len(clear))
                 changed = True
         if changed or self._snap is None:
             self._publish()
@@ -362,6 +383,7 @@ class UtilPlane:
             )
             self._live = _clear_slots(self._live, idx_p)
             self.repair_count += len(dead)
+            _m_repairs.inc(len(dead))
             self._publish()
         self._version = db.version
         return True
@@ -414,6 +436,7 @@ class UtilPlane:
         self._v = v
         self._version = version
         self.rebuild_count += 1
+        _m_rebuilds.inc()
         self._publish()
 
     # -- reads (published epoch) ------------------------------------------
@@ -421,6 +444,7 @@ class UtilPlane:
     def _publish(self) -> None:
         self._snap = self._live
         self.epoch += 1
+        _m_epoch.set(self.epoch)
         self._base_cache.clear()
 
     def snapshot(self) -> jax.Array:

@@ -17,8 +17,19 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from typing import Any, Optional, TextIO
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+_events_total = REGISTRY.counter(
+    "event_log_events_total", "control-plane events written to the JSONL log"
+)
+_rotations_total = REGISTRY.counter(
+    "event_log_rotations_total",
+    "event-log rotations (file reached Config.event_log_max_bytes)",
+)
 
 
 def _compact(value: Any) -> Any:
@@ -58,13 +69,24 @@ class EventLogger:
     Attach with ``bus.tap(EventLogger(path))`` (the Controller does this
     when ``Config.event_log`` is set). ``close()`` flushes; the file is
     line-buffered so a crash loses at most the current line.
+
+    ``max_bytes`` > 0 caps the file: when a write pushes it past the
+    cap, the file rotates to ``<path>.1`` (replacing any previous
+    rotation) and a fresh ``<path>`` opens — a long-running controller
+    keeps at most ~2x ``max_bytes`` of event history instead of growing
+    the JSONL unboundedly. ``n_events`` counts across rotations.
     """
 
-    def __init__(self, path: str, clock=time.time) -> None:
+    def __init__(
+        self, path: str, clock=time.time, max_bytes: int = 0
+    ) -> None:
         self.path = path
         self.clock = clock
+        self.max_bytes = int(max_bytes)
         self._fh: Optional[TextIO] = open(path, "a", buffering=1)
+        self._size = self._fh.tell()
         self.n_events = 0
+        self.n_rotations = 0
 
     def __call__(self, event) -> None:
         if self._fh is None:
@@ -73,8 +95,25 @@ class EventLogger:
         if dataclasses.is_dataclass(event):
             for f in dataclasses.fields(event):
                 record[f.name] = _compact(getattr(event, f.name))
-        self._fh.write(json.dumps(record) + "\n")
+        line = json.dumps(record) + "\n"
+        self._fh.write(line)
+        self._size += len(line)
         self.n_events += 1
+        _events_total.inc()
+        if self.max_bytes > 0 and self._size >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Move the full file to ``<path>.1`` and reopen fresh. One
+        rotation slot is deliberate: the log is a flight recorder, not
+        an archive — the current plus previous windows bound disk use
+        while keeping at least ``max_bytes`` of trailing history."""
+        self._fh.close()
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", buffering=1)
+        self._size = 0
+        self.n_rotations += 1
+        _rotations_total.inc()
 
     def close(self) -> None:
         if self._fh is not None:

@@ -1,20 +1,28 @@
-"""Oracle timing + structured trace log (SURVEY §5: the reference has no
-tracing/profiling at all; its closest artifact is INFO-level handler
-logging).
+"""Oracle timing, request-scoped spans + structured trace log (SURVEY
+§5: the reference has no tracing/profiling at all; its closest artifact
+is INFO-level handler logging).
 
-Two layers:
+Layers:
 
 - :class:`OracleStats` — cheap always-on wall-time accounting of oracle
   invocations (a bounded deque per operation). The controller exposes it
   so operators can see route-compute latency percentiles without any
   profiler attached.
+- :class:`Span` / :func:`start_span` / :func:`span` — request-scoped
+  spans with parent/child links: one route request (packet-in ->
+  coalesce -> window dispatch -> reap -> batched encode -> sliced
+  install) yields one reconstructable span tree in the JSONL sink.
+  Fan-in (many packet-ins coalescing into one window) is recorded as
+  ``span_link`` records from the extra parents to the window span.
 - :func:`device_trace` — optional ``jax.profiler`` trace context writing
   a TensorBoard-compatible profile when ``Config.profile_dir`` is set;
   a no-op otherwise (the profiler is only imported when enabled).
 
-Both emit structured JSONL records through ``trace_event`` when a sink
-is installed (``set_trace_sink``), giving the structured event log the
-reference lacks.
+All layers emit structured JSONL records through ``trace_event`` when a
+sink is installed (``set_trace_sink``), giving the structured event log
+the reference lacks. Without a sink, spans collapse to a shared no-op
+singleton and ``trace_event`` is one ``is None`` test — the hot path
+pays nothing for the capability.
 """
 
 from __future__ import annotations
@@ -22,13 +30,21 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import logging
 import pathlib
 import statistics
+import threading
 import time
 from typing import Callable, Optional
 
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
 _sink: Optional[Callable[[dict], None]] = None
 _sink_file = None  # open handle when the sink is file-based
+_sink_errors = REGISTRY.counter(
+    "trace_sink_errors_total",
+    "trace sink callables that raised (record dropped, sink kept)",
+)
 
 
 def set_trace_sink(path_or_fn) -> None:
@@ -49,18 +65,154 @@ def set_trace_sink(path_or_fn) -> None:
 
 
 def trace_event(kind: str, **fields) -> None:
-    """Emit one structured trace record (no-op without a sink)."""
+    """Emit one structured trace record (no-op without a sink). A sink
+    that raises drops the record — never the caller: the sink is a tap
+    on the control plane, and a broken exporter must not take the bus
+    handler that happened to emit through it down with it."""
     if _sink is not None:
-        _sink({"ts": time.time(), "kind": kind, **fields})
+        try:
+            _sink({"ts": time.time(), "kind": kind, **fields})
+        except Exception:
+            _sink_errors.inc()
+            logging.getLogger("tracing").debug(
+                "trace sink raised; record dropped", exc_info=True
+            )
+
+
+# -- request-scoped spans --------------------------------------------------
+
+#: span-id allocator; ids are unique within one process/sink lifetime.
+#: 0 is reserved for "no parent" (a root span).
+_span_seq = 0
+
+
+class Span:
+    """One timed stage of a request, emitted as a single ``span`` JSONL
+    record at :meth:`end` (``t0``/``t1`` are ``perf_counter`` stamps, so
+    a reconstructed tree's stage ordering is monotonic even when the
+    wall clock steps). Create through :func:`start_span` (explicit
+    lifecycle — the coalescer parks spans across handler returns) or
+    :func:`span` (context manager)."""
+
+    __slots__ = ("id", "parent", "name", "t0", "fields", "_done")
+
+    def __init__(self, name: str, parent: int, **fields) -> None:
+        global _span_seq
+        _span_seq += 1
+        self.id = _span_seq
+        self.parent = parent
+        self.name = name
+        self.t0 = time.perf_counter()
+        self.fields = fields
+        self._done = False
+
+    def child(self, name: str, **fields) -> "Span":
+        return start_span(name, parent=self, **fields)
+
+    def link(self, parent: "Span") -> None:
+        """Record an ADDITIONAL parent (fan-in: many packet-ins feed one
+        coalesced window). The tree edge is ``self.parent``; links are
+        extra edges carried as their own records."""
+        if self._done:
+            return
+        trace_event("span_link", span=self.id, parent=parent.id)
+
+    def end(self, **fields) -> None:
+        """Emit the span record (idempotent; extra fields merge in)."""
+        if self._done:
+            return
+        self._done = True
+        t1 = time.perf_counter()
+        trace_event(
+            "span",
+            name=self.name,
+            span=self.id,
+            parent=self.parent,
+            t0=round(self.t0, 6),
+            t1=round(t1, 6),
+            wall_ms=round((t1 - self.t0) * 1e3, 3),
+            **{**self.fields, **fields},
+        )
+
+
+class _NullSpan:
+    """Shared do-nothing span handed out while no sink is installed, so
+    instrumented code threads span objects unconditionally but the
+    disabled path allocates nothing per request."""
+
+    __slots__ = ()
+    id = 0
+    parent = 0
+
+    def child(self, name: str, **fields) -> "_NullSpan":
+        return self
+
+    def link(self, parent) -> None:
+        pass
+
+    def end(self, **fields) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+def start_span(name: str, parent=None, **fields):
+    """Open a span (returns :data:`NULL_SPAN` when tracing is off).
+    ``parent`` is a Span or None (root). The caller owns the lifecycle:
+    call ``end()`` when the stage completes."""
+    if _sink is None:
+        return NULL_SPAN
+    pid = 0 if parent is None else parent.id
+    return Span(name, pid, **fields)
+
+
+@contextlib.contextmanager
+def span(name: str, parent=None, **fields):
+    """Context-manager form of :func:`start_span`."""
+    sp = start_span(name, parent=parent, **fields)
+    try:
+        yield sp
+    finally:
+        sp.end()
+
+
+def read_span_tree(records) -> dict[int, dict]:
+    """Rebuild span nodes from decoded JSONL records: ``{span_id:
+    {record..., "children": [ids], "links": [extra parent ids]}}``.
+    The jq-free offline half of the span channel (tests + tooling); the
+    README documents the jq one-liner equivalent."""
+    nodes: dict[int, dict] = {}
+    links: list[tuple[int, int]] = []
+    for rec in records:
+        if rec.get("kind") == "span":
+            nodes[rec["span"]] = {**rec, "children": [], "links": []}
+        elif rec.get("kind") == "span_link":
+            links.append((rec["span"], rec["parent"]))
+    for sid, node in nodes.items():
+        parent = nodes.get(node.get("parent", 0))
+        if parent is not None:
+            parent["children"].append(sid)
+    for sid, pid in links:
+        if sid in nodes:
+            nodes[sid]["links"].append(pid)
+    return nodes
 
 
 class OracleStats:
-    """Bounded per-operation wall-time samples with summary figures."""
+    """Bounded per-operation wall-time samples with summary figures.
+
+    Appends take a lock (deque.append is atomic, but ``summary`` sorts
+    the deque, and CPython raises ``deque mutated during iteration``
+    when an append from another thread — the RPC event loop reading
+    while the bus thread records — lands mid-sort); ``summary`` copies
+    under the same lock and computes on the copy."""
 
     def __init__(self, maxlen: int = 512) -> None:
         self.samples: dict[str, collections.deque] = collections.defaultdict(
             lambda: collections.deque(maxlen=maxlen)
         )
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def timed(self, op: str, **fields):
@@ -69,21 +221,30 @@ class OracleStats:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.samples[op].append(dt)
+            with self._lock:
+                self.samples[op].append(dt)
             trace_event("oracle", op=op, wall_ms=round(dt * 1e3, 3), **fields)
 
     def summary(self) -> dict[str, dict]:
+        with self._lock:
+            copies = {op: list(xs) for op, xs in self.samples.items()}
         out = {}
-        for op, xs in self.samples.items():
-            data = sorted(xs)
+        for op, data in copies.items():
+            data.sort()
             n = len(data)
             if n == 0:  # defaultdict read-access can leave empty deques
                 continue
+            # nearest-rank percentiles: p = ceil(q * n)-th smallest
+            # sample (1-based). The old (99 * n) // 100 index was biased
+            # a full rank high at small n (n=100 -> the max, not the
+            # 99th sample).
+            p50 = data[min(n - 1, (n + 1) // 2 - 1)]
+            p99 = data[min(n - 1, (99 * n + 99) // 100 - 1)]
             out[op] = {
                 "count": n,
                 "mean_ms": round(statistics.fmean(data) * 1e3, 3),
-                "p50_ms": round(data[n // 2] * 1e3, 3),
-                "p99_ms": round(data[min(n - 1, (99 * n) // 100)] * 1e3, 3),
+                "p50_ms": round(p50 * 1e3, 3),
+                "p99_ms": round(p99 * 1e3, 3),
                 "max_ms": round(data[-1] * 1e3, 3),
             }
         return out
@@ -97,7 +258,13 @@ STATS = OracleStats()
 #: when XLA actually traces — so the counter measures jit-cache misses,
 #: not dispatches. Tests use it to assert the batch-length bucketing
 #: keeps the cache bounded (one trace per bucket, not per length).
-TRACE_COUNTS: collections.Counter = collections.Counter()
+#: Storage lives in the metrics registry (``jit_traces_total{kernel=*}``
+#: in the exposition) so the telemetry plane sees compile churn live;
+#: this name remains the mutable Counter the probes and tests use.
+_JIT_TRACES = REGISTRY.labeled_counter(
+    "jit_traces_total", "kernel", "XLA traces per jitted oracle kernel"
+)
+TRACE_COUNTS: collections.Counter = _JIT_TRACES.values
 
 
 def count_trace(kernel: str) -> None:

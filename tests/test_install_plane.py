@@ -381,6 +381,120 @@ class TestRevalidationGate:
         assert counts["n"] == 1  # NOT skipped
 
 
+# -- batched teardown bursts (ISSUE 4 satellite) ---------------------------
+
+
+class TestTeardownBursts:
+    """Revalidation/exit teardowns ride the PR-3 window installer as
+    batched OFPFC_DELETEs; the scalar per-mod path
+    (pipelined_install=False) is the differential reference."""
+
+    def _warm(self, fabric):
+        for src, dst in (
+            (MAC[1], MAC[3]), (MAC[2], MAC[4]), (MAC[3], MAC[2]),
+        ):
+            fabric.hosts[src].send(of.Packet(src, dst, payload=b"x"))
+
+    @pytest.mark.parametrize("wire", [False, True], ids=["sim", "wire"])
+    def test_revalidation_teardown_differential(self, wire):
+        """Cutting the only path tears every crossing flow down; the
+        batched-delete leg must leave switches in exactly the scalar
+        leg's state (both simulated and over real wire bytes)."""
+        batch_fab, batch_ctl = make_stack("py", wire=wire)
+        scalar_fab, scalar_ctl = make_stack(
+            "py", wire=wire, pipelined_install=False
+        )
+        for fab in (batch_fab, scalar_fab):
+            self._warm(fab)
+        assert flow_state(batch_fab) == flow_state(scalar_fab) != set()
+        for fab in (batch_fab, scalar_fab):
+            fab.remove_link(2, 2, 3, 1)  # partition the line
+        assert flow_state(batch_fab) == flow_state(scalar_fab)
+        assert set(batch_ctl.router.fdb.entries()) == set(
+            scalar_ctl.router.fdb.entries()
+        )
+        # the crossing flows are really gone from the switches
+        assert not any(
+            e.match.dl_src == MAC[1] and e.match.dl_dst == MAC[3]
+            for sw in batch_fab.switches.values() for e in sw.flow_table
+        )
+
+    def test_teardown_goes_through_batched_deletes(self):
+        """The batched leg must actually use ONE OFPFC_DELETE window,
+        not scalar per-mod deletes."""
+        fabric, controller = make_stack("py")
+        self._warm(fabric)
+        windows = []
+        scalar_deletes = []
+        orig_window = fabric.flow_mods_window
+        orig_mod = fabric.flow_mod
+
+        def spy_window(dpids, batch):
+            windows.append((np.asarray(dpids).copy(), batch))
+            orig_window(dpids, batch)
+
+        def spy_mod(dpid, mod):
+            if mod.command == of.OFPFC_DELETE:
+                scalar_deletes.append((dpid, mod))
+            orig_mod(dpid, mod)
+
+        fabric.flow_mods_window = spy_window
+        fabric.flow_mod = spy_mod
+        fabric.remove_link(2, 2, 3, 1)
+        deletes = [
+            (d, b) for d, b in windows if b.command == of.OFPFC_DELETE
+        ]
+        assert len(deletes) == 1  # one burst for the whole pass
+        assert not scalar_deletes
+        dpids, burst = deletes[0]
+        assert len(burst) == len(dpids) >= 2
+        # grouped: equal dpids contiguous (the window-send contract)
+        assert list(dpids) == sorted(dpids)
+
+    def test_process_delete_teardown_differential(self):
+        """A rank exit's vMAC teardown burst: batched vs scalar leave
+        identical switch state."""
+        stacks = [
+            make_stack("py"),
+            make_stack("py", pipelined_install=False),
+        ]
+        vmac = VirtualMac(CollectiveType.P2P, 0, 1).encode()
+        for fabric, controller in stacks:
+            for mac, rank in ((MAC[1], 0), (MAC[3], 1)):
+                fabric.hosts[mac].send(of.Packet(
+                    mac, "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+                    udp_dst=61000,
+                    payload=Announcement(
+                        AnnouncementType.LAUNCH, rank
+                    ).encode(),
+                ))
+            fabric.hosts[MAC[1]].send(of.Packet(MAC[1], vmac, payload=b"m"))
+            assert any(
+                e.match.dl_dst == vmac
+                for sw in fabric.switches.values() for e in sw.flow_table
+            )
+            controller.bus.publish(ev.EventProcessDelete(1))
+        (batch_fab, _), (scalar_fab, _) = stacks
+        assert flow_state(batch_fab) == flow_state(scalar_fab)
+        for fabric, _ in stacks:
+            assert not any(
+                e.match.dl_dst == vmac
+                for sw in fabric.switches.values() for e in sw.flow_table
+            )
+
+    def test_scalar_escape_hatch_never_batches_deletes(self):
+        """pipelined_install=False must reach the scalar per-mod DELETE
+        encode path, even on a batch-capable southbound."""
+        fabric, controller = make_stack("py", pipelined_install=False)
+        self._warm(fabric)
+        batched = []
+        fabric.flow_mods_window = lambda *a, **k: batched.append(1)
+        fabric.flow_mods_batch = lambda *a, **k: batched.append(1)
+        fabric.remove_link(2, 2, 3, 1)
+        assert not batched
+        assert not controller.router.fdb.exists(2, MAC[1], MAC[3])
+
+
 # -- config 10 bench machinery --------------------------------------------
 
 

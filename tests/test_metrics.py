@@ -1,0 +1,451 @@
+"""Metrics registry (utils/metrics.py) + text exposition
+(api/telemetry.py) + the one-registry contract between the RPC
+telemetry feed and the Prometheus rendering (ISSUE 4)."""
+
+import json
+import tracemalloc
+
+import pytest
+
+from sdnmpi_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCounter,
+    MetricsRegistry,
+    REGISTRY,
+)
+
+
+class TestInstruments:
+    def test_counter(self):
+        c = Counter("c")
+        c.inc()
+        c.inc(41)
+        assert c.value == 42
+
+    def test_gauge(self):
+        g = Gauge("g")
+        g.set(7.5)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 8.0
+
+    def test_histogram_buckets_and_sum(self):
+        h = Histogram("h", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 1.0, 5.0, 50.0, 500.0):
+            h.observe(v)
+        # bucket edges are inclusive upper bounds; last slot is +Inf
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5
+        assert h.sum == pytest.approx(556.5)
+
+    def test_histogram_rejects_unsorted_buckets(self):
+        with pytest.raises(ValueError):
+            Histogram("bad", buckets=(10.0, 1.0))
+
+    def test_labeled_counter(self):
+        f = LabeledCounter("f", "kernel")
+        f.inc("a")
+        f.inc("a")
+        f.inc("b", 3)
+        assert f.values["a"] == 2 and f.values["b"] == 3
+
+
+class TestRegistry:
+    def test_idempotent_registration(self):
+        r = MetricsRegistry()
+        a = r.counter("x_total")
+        b = r.counter("x_total")
+        assert a is b
+        a.inc()
+        assert b.value == 1
+
+    def test_kind_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(ValueError):
+            r.gauge("x")
+
+    def test_histogram_bucket_conflict_raises(self):
+        """Re-registering with different buckets must fail loudly, not
+        silently hand back the wrong-bucketed instrument."""
+        r = MetricsRegistry()
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+        assert r.histogram("h_seconds", buckets=(0.1, 1.0)) is h
+        with pytest.raises(ValueError):
+            r.histogram("h_seconds", buckets=(1, 100))
+
+    def test_labeled_counter_label_conflict_raises(self):
+        r = MetricsRegistry()
+        r.labeled_counter("t_total", "kernel")
+        with pytest.raises(ValueError):
+            r.labeled_counter("t_total", "op")
+
+    def test_snapshot_shape_and_isolation(self):
+        r = MetricsRegistry()
+        r.counter("c_total").inc(3)
+        r.gauge("g").set(1.5)
+        h = r.histogram("h_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        r.labeled_counter("t_total", "kernel").inc("k1", 2)
+        snap = r.snapshot()
+        assert snap["counters"]["c_total"] == 3
+        assert snap["counters"]["t_total{kernel=k1}"] == 2
+        assert snap["gauges"]["g"] == 1.5
+        assert snap["histograms"]["h_seconds"]["counts"] == [1, 0, 0]
+        # snapshot is a copy: mutating it must not touch the live state
+        snap["histograms"]["h_seconds"]["counts"][0] = 99
+        assert h.counts[0] == 1
+        # and it is JSON-safe end to end
+        json.dumps(snap)
+
+    def test_reset_preserves_instrument_identity(self):
+        r = MetricsRegistry()
+        c = r.counter("c_total")
+        c.inc(5)
+        h = r.histogram("h", buckets=(1.0,))
+        h.observe(0.5)
+        r.reset()
+        assert c.value == 0 and r.counter("c_total") is c
+        assert h.counts == [0, 0] and h.count == 0 and h.sum == 0.0
+
+
+class TestHotPathOverhead:
+    """The tier-1 disabled-path bound the ISSUE asks for: instrumented
+    hot loops must stay within a small multiple of uninstrumented ones
+    and must not allocate per call when no exporter is attached."""
+
+    N = 50_000
+
+    def test_counter_overhead_bounded(self):
+        import timeit
+
+        c = Counter("bench")
+        plain = timeit.timeit("x += 1", setup="x = 0", number=self.N)
+        instrumented = timeit.timeit(
+            "c.inc()", globals={"c": c}, number=self.N
+        )
+        # attribute add vs local add: genuinely a handful of bytecodes.
+        # The bound is generous (20x) to keep slow/contended CI honest
+        # while still catching an accidental lock, dict lookup chain, or
+        # string format sneaking into the hot path.
+        assert instrumented < plain * 20
+
+    def test_histogram_overhead_bounded(self):
+        import timeit
+
+        h = Histogram("bench_h")
+        plain = timeit.timeit("x += 1", setup="x = 0", number=self.N)
+        instrumented = timeit.timeit(
+            "h.observe(0.005)", globals={"h": h}, number=self.N
+        )
+        assert instrumented < plain * 40
+
+    def test_no_retained_allocations_per_call(self):
+        """100k observations while no exporter is attached must not grow
+        memory: instruments accumulate in place (fixed bucket lists,
+        scalar slots) — no per-call record objects are retained."""
+        c = Counter("alloc_c")
+        h = Histogram("alloc_h", buckets=(0.001, 0.01, 0.1))
+        g = Gauge("alloc_g")
+        # warm up: first calls may cache small ints / specialize
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.005)
+            g.set(1.0)
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for _ in range(100_000):
+            c.inc()
+            h.observe(0.005)
+            g.set(1.0)
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        growth = sum(
+            s.size_diff for s in after.compare_to(before, "filename")
+            if s.size_diff > 0
+        )
+        # boxing churn is transient; RETAINED growth across 300k calls
+        # must stay trivially small (a few KB of interpreter noise)
+        assert growth < 64 * 1024, f"retained {growth} bytes over 300k calls"
+
+
+class TestExposition:
+    def _registry(self):
+        r = MetricsRegistry()
+        r.counter("requests_total").inc(7)
+        r.gauge("depth").set(3.0)
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        r.labeled_counter("jit_traces_total", "kernel").inc("apsp", 2)
+        return r
+
+    def test_render_prometheus_text(self):
+        from sdnmpi_tpu.api.telemetry import render
+
+        text = render(self._registry().snapshot())
+        lines = set(text.splitlines())
+        assert "requests_total 7" in lines
+        assert "depth 3.0" in lines
+        # histogram buckets are CUMULATIVE, with the +Inf synthetic edge
+        assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+        assert 'lat_seconds_bucket{le="1.0"} 2' in lines
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in lines
+        assert "lat_seconds_count 3" in lines
+        assert 'jit_traces_total{kernel="apsp"} 2' in lines
+
+    def test_oracle_summary_flattens_to_gauges(self):
+        from sdnmpi_tpu.api.telemetry import render
+
+        snap = self._registry().snapshot()
+        snap["oracle"] = {"routes_batch": {"count": 4, "p99_ms": 1.25}}
+        text = render(snap)
+        assert "oracle_routes_batch_count 4" in text
+        assert "oracle_routes_batch_p99_ms 1.25" in text
+
+    def test_label_values_escaped(self):
+        """A hostile label value (quotes, backslashes, braces) must not
+        produce an exposition the Prometheus parser rejects wholesale."""
+        from sdnmpi_tpu.api.telemetry import render
+
+        r = MetricsRegistry()
+        f = r.labeled_counter("odd_total", "k")
+        f.inc('va"l\\ue}')
+        text = render(r.snapshot())
+        assert 'odd_total{k="va\\"l\\\\ue}"} 1' in text
+
+    def test_dump_writes_file(self, tmp_path):
+        from sdnmpi_tpu.api import telemetry
+
+        path = tmp_path / "metrics.prom"
+        text = telemetry.dump(str(path), snapshot=self._registry().snapshot())
+        assert path.read_text() == text
+        assert "requests_total 7" in text
+
+    def test_env_dump_hook(self, tmp_path, monkeypatch):
+        from sdnmpi_tpu.api import telemetry
+
+        monkeypatch.delenv(telemetry.DUMP_ENV, raising=False)
+        assert not telemetry.install_env_dump_hook()
+        monkeypatch.setenv(telemetry.DUMP_ENV, str(tmp_path / "m.prom"))
+        assert telemetry.install_env_dump_hook()
+
+
+class TestOneRegistryContract:
+    """Acceptance: update_telemetry over the RPC interface and the text
+    exposition report the same counter/histogram values from ONE
+    registry."""
+
+    def test_rpc_feed_matches_exposition(self):
+        from sdnmpi_tpu.api.rpc import RPCInterface
+        from sdnmpi_tpu.api.telemetry import render
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+
+        fabric = Fabric()
+        fabric.add_switch(1)
+        fabric.add_host("04:00:00:00:00:01", 1, 2)
+        fabric.add_host("04:00:00:00:00:02", 1, 3)
+        controller = Controller(
+            fabric, Config(oracle_backend="py", enable_monitor=False)
+        )
+        controller.attach()
+        rpc = RPCInterface(controller.bus, controller.config)
+
+        received = []
+
+        class Client:
+            def send_json(self, message):
+                received.append(message)
+
+        rpc.attach_client(Client())
+        received.clear()  # drop the init_* snapshot calls
+
+        # traffic so the pipeline counters move
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric.hosts["04:00:00:00:00:01"].send(of.Packet(
+            eth_src="04:00:00:00:00:01", eth_dst="04:00:00:00:00:02",
+            payload=b"x",
+        ))
+        controller.bus.publish(ev.EventStatsFlush())
+
+        updates = [m for m in received if m["method"] == "update_telemetry"]
+        assert len(updates) == 1
+        snap = updates[0]["params"][0]
+        assert snap["counters"]["router_packet_ins_total"] >= 1
+        # the exposition renders the SAME values the RPC feed carried
+        text = render(snap)
+        for name, value in snap["counters"].items():
+            if "{" in name:
+                continue  # labeled form asserted in TestExposition
+            assert f"{name} {value}" in text
+        for name, h in snap["histograms"].items():
+            assert f"{name}_count {h['count']}" in text
+        # and both agree with a fresh read of the one live registry on
+        # every counter that cannot move between flush and re-read
+        live = controller.telemetry()
+        assert (
+            live["counters"]["router_packet_ins_total"]
+            == snap["counters"]["router_packet_ins_total"]
+        )
+
+    def test_no_clients_no_snapshot_work(self):
+        """The disabled path: without attached clients the flush handler
+        must not build a snapshot (near-zero overhead requirement)."""
+        from sdnmpi_tpu.api.rpc import RPCInterface
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.control.bus import EventBus
+
+        bus = EventBus()
+        rpc = RPCInterface(bus, Config())
+        calls = {"n": 0}
+        bus.provide(
+            ev.TelemetryRequest,
+            lambda req: calls.__setitem__("n", calls["n"] + 1)
+            or ev.TelemetryReply({}),
+        )
+        bus.publish(ev.EventStatsFlush())
+        assert calls["n"] == 0
+        # bare attach (no init snapshot: this minimal bus has no
+        # Current* providers) — presence alone must arm the feed
+        rpc.clients.append(type("C", (), {"send_json": lambda s, m: None})())
+        bus.publish(ev.EventStatsFlush())
+        assert calls["n"] == 1
+
+
+class TestCoalescerWindowMetrics:
+    def test_window_age_measured_per_window_not_per_queue(self):
+        """Three windows cut from one flush must each sample THEIR
+        oldest member's park age — not the whole queue's first park
+        (which would fold earlier windows' dispatch+install time into
+        later windows' samples)."""
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric = Fabric()
+        fabric.add_switch(1)
+        macs = [f"04:00:00:00:00:0{i}" for i in range(1, 7)]
+        for i, m in enumerate(macs):
+            fabric.add_host(m, 1, i + 2)
+        controller = Controller(fabric, Config(
+            oracle_backend="py", enable_monitor=False,
+            coalesce_routes=True, coalesce_window_s=100.0,
+            coalesce_max_batch=2,  # 5 parked lookups -> 3 windows
+        ))
+        controller.attach()
+        h = REGISTRY.get("coalescer_window_age_seconds")
+        count0, sum0 = h.count, h.sum
+        controller.router._flushing = True  # park without auto-flush
+        for src, dst in [
+            (macs[0], macs[1]), (macs[2], macs[3]), (macs[4], macs[5]),
+            (macs[1], macs[0]), (macs[3], macs[2]),
+        ]:
+            controller.bus.publish(ev.EventPacketIn(
+                1, 2, of.Packet(src, dst, payload=b"x"), of.OFP_NO_BUFFER
+            ))
+        controller.router._flushing = False
+        controller.router.flush_routes()
+        assert h.count - count0 == 3
+        # all five parks happened microseconds ago; per-window ages must
+        # all be tiny (queue-t0 accounting would still pass here, but
+        # ages can never exceed the park-to-now wall — sanity-bound it)
+        assert (h.sum - sum0) < 5.0
+
+    def test_inflight_gauge_survives_raising_reap(self):
+        """A window whose reap raises (device error) must not pin
+        pipeline_inflight_windows — the controller outlives the
+        window."""
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric = Fabric()
+        fabric.add_switch(1)
+        fabric.add_host("04:00:00:00:00:01", 1, 2)
+        fabric.add_host("04:00:00:00:00:02", 1, 3)
+        controller = Controller(fabric, Config(
+            oracle_backend="py", enable_monitor=False,
+            coalesce_routes=True, coalesce_window_s=100.0,
+        ))
+        controller.attach()
+
+        class ExplodingWindow:
+            def reap(self):
+                raise RuntimeError("device died")
+
+        controller.bus._request_handlers[ev.DispatchRoutesBatchRequest] = (
+            lambda req: ev.DispatchRoutesBatchReply(ExplodingWindow())
+        )
+        controller.bus.publish(ev.EventPacketIn(
+            1, 2, of.Packet("04:00:00:00:00:01", "04:00:00:00:00:02",
+                            payload=b"x"),
+            of.OFP_NO_BUFFER,
+        ))
+        with pytest.raises(RuntimeError):
+            controller.router.flush_routes()
+        assert REGISTRY.get("pipeline_inflight_windows").value == 0
+        assert not controller.router._flushing  # can keep routing
+
+    def test_overlap_gain_set_after_flush(self):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric = Fabric()
+        fabric.add_switch(1)
+        fabric.add_host("04:00:00:00:00:01", 1, 2)
+        fabric.add_host("04:00:00:00:00:02", 1, 3)
+        controller = Controller(fabric, Config(
+            oracle_backend="py", enable_monitor=False,
+            coalesce_routes=True, coalesce_window_s=100.0,
+        ))
+        controller.attach()
+        fabric.hosts["04:00:00:00:00:01"].send(of.Packet(
+            "04:00:00:00:00:01", "04:00:00:00:00:02", payload=b"x",
+        ))
+        gain = REGISTRY.get("pipeline_overlap_gain").value
+        # single-window flush: no overlap possible, the serial-equivalent
+        # estimate stays near the achieved wall
+        assert 0.0 < gain < 2.0
+
+
+def test_global_registry_has_pipeline_instruments():
+    """The instruments ISSUE 4 names exist in the process registry once
+    the pipeline modules are imported."""
+    import sdnmpi_tpu.control.router  # noqa: F401
+    import sdnmpi_tpu.control.southbound  # noqa: F401
+    import sdnmpi_tpu.oracle.engine  # noqa: F401
+    import sdnmpi_tpu.oracle.utilplane  # noqa: F401
+    import sdnmpi_tpu.utils.event_log  # noqa: F401
+
+    for name in (
+        "coalescer_window_occupancy",
+        "coalescer_window_age_seconds",
+        "pipeline_inflight_windows",
+        "pipeline_reap_seconds",
+        "install_e2e_seconds",
+        "pipeline_overlap_gain",
+        "southbound_encode_bytes_total",
+        "southbound_install_slices_total",
+        "southbound_drops_total",
+        "utilplane_flushes_total",
+        "utilplane_epoch",
+        "oracle_repairs_total",
+        "oracle_full_refreshes_total",
+        "jit_traces_total",
+        "event_log_events_total",
+    ):
+        assert REGISTRY.get(name) is not None, name

@@ -1,10 +1,21 @@
-"""Tests for oracle timing stats and the structured trace log."""
+"""Tests for oracle timing stats, the structured trace log, and the
+request-scoped span channel (ISSUE 4)."""
 
 import json
+import threading
 
 import pytest
 
-from sdnmpi_tpu.utils.tracing import OracleStats, STATS, set_trace_sink, trace_event
+from sdnmpi_tpu.utils.tracing import (
+    NULL_SPAN,
+    OracleStats,
+    STATS,
+    read_span_tree,
+    set_trace_sink,
+    span,
+    start_span,
+    trace_event,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -51,6 +62,261 @@ class TestTraceSink:
         set_trace_sink(None)
         trace_event("y")
         assert len(records) == 1  # disabled: nothing new
+
+
+class TestOracleStatsPercentiles:
+    def test_p99_nearest_rank_at_small_n(self):
+        """Nearest-rank p99 of n samples is the ceil(0.99 n)-th smallest
+        — at n=100 that's the 99th sample, NOT the max (the old
+        (99n)//100 index was biased one rank high)."""
+        stats = OracleStats(maxlen=1024)
+        for v in range(1, 101):  # 1..100 ms
+            stats.samples["op"].append(v / 1000)
+        s = stats.summary()["op"]
+        assert s["p99_ms"] == 99.0
+        assert s["max_ms"] == 100.0
+        assert s["p50_ms"] == 50.0
+
+    def test_p99_single_sample(self):
+        stats = OracleStats()
+        stats.samples["op"].append(0.004)
+        assert stats.summary()["op"]["p99_ms"] == 4.0
+
+    def test_summary_safe_under_concurrent_appends(self):
+        """The RPC reader snapshots while the bus thread records: no
+        'deque mutated during iteration' and no torn reads."""
+        stats = OracleStats(maxlen=256)
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                with stats.timed("op"):
+                    pass
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(200):
+                s = stats.summary()
+                if "op" in s:
+                    assert s["op"]["count"] >= 1
+        finally:
+            stop.set()
+            t.join()
+
+
+class TestSinkLifecycle:
+    def test_file_sink_replaced_closes_old_handle(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        set_trace_sink(a)
+        trace_event("one")
+        import sdnmpi_tpu.utils.tracing as tracing
+
+        old = tracing._sink_file
+        set_trace_sink(b)
+        assert old.closed
+        trace_event("two")
+        assert "one" in a.read_text() and "two" in b.read_text()
+        assert "two" not in a.read_text()
+
+    def test_callable_sink_exception_does_not_kill_caller(self):
+        """A broken exporter drops records, never the bus handler that
+        emitted through it (the tap survives)."""
+        from sdnmpi_tpu.utils.metrics import REGISTRY
+
+        errors = REGISTRY.counter("trace_sink_errors_total")
+        before = errors.value
+
+        def exploding(rec):
+            raise RuntimeError("exporter died")
+
+        set_trace_sink(exploding)
+        trace_event("x")  # must not raise
+        with STATS.timed("sink_crash_op"):
+            pass  # the timed() finally path emits too — must not raise
+        assert errors.value >= before + 2
+
+    def test_disable_closes_file_sink(self, tmp_path):
+        set_trace_sink(tmp_path / "c.jsonl")
+        import sdnmpi_tpu.utils.tracing as tracing
+
+        fh = tracing._sink_file
+        set_trace_sink(None)
+        assert fh.closed and tracing._sink is None
+
+
+class TestSpans:
+    def test_null_span_without_sink(self):
+        set_trace_sink(None)
+        sp = start_span("anything")
+        assert sp is NULL_SPAN
+        assert sp.child("x") is NULL_SPAN
+        sp.end()  # no-op, no error
+
+    def test_span_records_parent_and_wall(self):
+        records = []
+        set_trace_sink(records.append)
+        root = start_span("request", dpid=1)
+        child = root.child("stage")
+        child.end(n=3)
+        root.end()
+        spans = {r["name"]: r for r in records if r["kind"] == "span"}
+        assert spans["stage"]["parent"] == spans["request"]["span"]
+        assert spans["request"]["parent"] == 0
+        assert spans["stage"]["n"] == 3
+        assert spans["request"]["dpid"] == 1
+        assert spans["stage"]["t1"] >= spans["stage"]["t0"]
+
+    def test_span_end_idempotent(self):
+        records = []
+        set_trace_sink(records.append)
+        sp = start_span("once")
+        sp.end()
+        sp.end()
+        assert len([r for r in records if r["kind"] == "span"]) == 1
+
+    def test_context_manager_form(self):
+        records = []
+        set_trace_sink(records.append)
+        with span("cm") as sp:
+            with span("inner", parent=sp):
+                pass
+        spans = {r["name"]: r for r in records if r["kind"] == "span"}
+        assert spans["inner"]["parent"] == spans["cm"]["span"]
+
+    def test_fan_in_links(self):
+        records = []
+        set_trace_sink(records.append)
+        a = start_span("pkt_a")
+        b = start_span("pkt_b")
+        w = a.child("window")
+        w.link(b)
+        w.end()
+        a.end()
+        b.end()
+        tree = read_span_tree(records)
+        wid = next(s for s, n in tree.items() if n["name"] == "window")
+        assert tree[wid]["links"] == [b.id]
+        assert wid in tree[a.id]["children"]
+
+
+class TestSpanTreeEndToEnd:
+    """Acceptance: one coalesced route request (packet-in -> window
+    dispatch -> reap -> batched encode -> sliced install) produces a
+    single span tree in the JSONL sink with monotonically ordered stage
+    timestamps and correct parent/child links."""
+
+    MACS = [f"04:00:00:00:00:0{i}" for i in range(1, 5)]
+
+    def _stack(self):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.control.fabric import Fabric
+
+        fabric = Fabric(wire=True)
+        for dpid in (1, 2, 3):
+            fabric.add_switch(dpid)
+        fabric.add_link(1, 1, 2, 1)
+        fabric.add_link(2, 2, 3, 1)
+        hosts = [
+            fabric.add_host(self.MACS[0], 1, 2),
+            fabric.add_host(self.MACS[1], 1, 3),
+            fabric.add_host(self.MACS[2], 3, 2),
+            fabric.add_host(self.MACS[3], 3, 3),
+        ]
+        config = Config(
+            oracle_backend="py", enable_monitor=False,
+            coalesce_routes=True, coalesce_window_s=10.0,
+        )
+        controller = Controller(fabric, config)
+        controller.attach()
+        return fabric, controller, hosts
+
+    def test_one_request_one_tree(self, tmp_path):
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric, controller, hosts = self._stack()
+        path = tmp_path / "trace.jsonl"
+        set_trace_sink(path)
+        hosts[0].send(of.Packet(
+            eth_src=self.MACS[0], eth_dst=self.MACS[2], payload=b"x",
+        ))
+        set_trace_sink(None)
+        records = [
+            json.loads(line) for line in path.read_text().splitlines()
+        ]
+        tree = read_span_tree(records)
+        by_name = {}
+        for sid, node in tree.items():
+            by_name.setdefault(node["name"], []).append(node)
+        # exactly one span per stage for one request
+        for name in (
+            "packet_in", "coalesce_park", "route_window", "dispatch",
+            "reap", "install", "southbound_send",
+        ):
+            assert len(by_name.get(name, [])) == 1, (name, sorted(by_name))
+        pkt = by_name["packet_in"][0]
+        window = by_name["route_window"][0]
+        # single tree: every span reaches the packet-in root
+        assert pkt["parent"] == 0
+        roots = [n for n in tree.values() if n["parent"] == 0]
+        assert len(roots) == 1
+        # parent/child links: park under packet; window under packet;
+        # dispatch/reap/install under window; send under install
+        assert by_name["coalesce_park"][0]["parent"] == pkt["span"]
+        assert window["parent"] == pkt["span"]
+        for stage in ("dispatch", "reap", "install"):
+            assert by_name[stage][0]["parent"] == window["span"], stage
+        assert (
+            by_name["southbound_send"][0]["parent"]
+            == by_name["install"][0]["span"]
+        )
+        # monotonically ordered stage timestamps along the pipeline
+        t = [
+            by_name[name][0]["t0"]
+            for name in (
+                "packet_in", "coalesce_park", "route_window", "dispatch",
+                "reap", "install", "southbound_send",
+            )
+        ]
+        assert t == sorted(t)
+        # and the window span carries the batch size
+        assert window["n_pairs"] == 1
+
+    def test_fan_in_recorded_as_links(self, tmp_path):
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric, controller, hosts = self._stack()
+        path = tmp_path / "trace.jsonl"
+        set_trace_sink(path)
+        # three packet-ins park before one flush: one window, three roots
+        for src, dst in (
+            (self.MACS[0], self.MACS[2]),
+            (self.MACS[1], self.MACS[3]),
+            (self.MACS[0], self.MACS[3]),
+        ):
+            controller.bus.publish(ev.EventPacketIn(
+                1, 2, of.Packet(eth_src=src, eth_dst=dst, payload=b"z"),
+                of.OFP_NO_BUFFER,
+            ))
+        controller.router.flush_routes()
+        set_trace_sink(None)
+        records = [
+            json.loads(line) for line in path.read_text().splitlines()
+        ]
+        tree = read_span_tree(records)
+        windows = [n for n in tree.values() if n["name"] == "route_window"]
+        assert len(windows) == 1
+        w = windows[0]
+        assert w["n_pairs"] == 3
+        pkt_ids = sorted(
+            n["span"] for n in tree.values() if n["name"] == "packet_in"
+        )
+        assert len(pkt_ids) == 3
+        # tree edge to the first packet; links to the other two
+        assert w["parent"] == pkt_ids[0]
+        assert sorted(tree[w["span"]]["links"]) == pkt_ids[1:]
 
 
 def test_oracle_invocations_recorded():
