@@ -243,5 +243,9 @@ if __name__ == "__main__":
         from benchmarks.config10_pipeline import main as pipeline_main
 
         pipeline_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "recovery":
+        from benchmarks.config11_recovery import main as recovery_main
+
+        recovery_main()
     else:
         main()

@@ -35,6 +35,7 @@ CONFIGS = [
     ("8", [sys.executable, "-m", "benchmarks.config8_churn"]),
     ("9", [sys.executable, "-m", "benchmarks.config9_utilplane"]),
     ("10", [sys.executable, "-m", "benchmarks.config10_pipeline"]),
+    ("11", [sys.executable, "-m", "benchmarks.config11_recovery"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

@@ -169,6 +169,41 @@ class Config:
     #: semantics, bit-identical to the host dict rebuild).
     util_stale_horizon_s: float = 0.0
 
+    # --- recovery plane (control/recovery.py; ISSUE 5) --------------------
+    #: master switch for the failure-domain recovery plane: desired-flow
+    #: reconciliation on EventDatapathUp, the bounded install retry
+    #: queue, and the anti-entropy pass per EventStatsFlush. False
+    #: restores the fire-and-forget legacy (the differential-testing
+    #: path); the desired store is still maintained either way, so
+    #: flipping the flag live loses no state.
+    recovery_plane: bool = True
+    #: terminate every batched install window with an
+    #: OFPT_BARRIER_REQUEST per switch span — the barrier reply is the
+    #: install's end-to-end receipt (EventBarrierAck -> the
+    #: barrier_rtt_seconds histogram); a window whose ack never arrives
+    #: is re-driven by the anti-entropy pass. False sends bare windows
+    #: (the pre-recovery wire byte stream).
+    install_barriers: bool = True
+    #: seconds an install window may await its barrier ack before the
+    #: anti-entropy pass treats it as lost and resyncs the switch
+    barrier_timeout_s: float = 2.0
+    #: bounded retries per switch for dropped/un-acked install windows;
+    #: exhaustion escalates to a full datapath resync (table wipe +
+    #: EventDatapathUp re-drive) instead of silent divergence
+    install_retry_max: int = 4
+    #: base of the retry queue's exponential backoff (doubles per
+    #: attempt, +25% seeded jitter so a fabric-wide fault does not
+    #: re-drive every switch in lockstep)
+    install_retry_backoff_s: float = 0.25
+    #: controller-side echo keepalive period for real TCP datapaths
+    #: (control/southbound.py): a half-open peer otherwise stays
+    #: "connected" forever and EventDatapathDown never fires. 0
+    #: disables probing.
+    echo_interval_s: float = 15.0
+    #: seconds without an OFPT_ECHO_REPLY before a probed datapath is
+    #: aborted (echo_timeouts_total counts the kills)
+    echo_timeout_s: float = 45.0
+
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves
     #: /v1.0/sdnmpi/ws via Ryu's WSGI server, sdnmpi/rpc_interface.py:104)

@@ -49,6 +49,14 @@ class Controller:
         if hasattr(southbound, "install_highwater"):
             # batched-install backpressure cap (see OFSouthbound)
             southbound.install_highwater = config.install_highwater
+        if hasattr(southbound, "send_barriers"):
+            # acked installs: barrier-terminated windows (ISSUE 5)
+            southbound.send_barriers = config.install_barriers
+        if hasattr(southbound, "echo_interval"):
+            # controller-side keepalive knobs (the launcher arms the
+            # loop; echo_tick is also callable synchronously in tests)
+            southbound.echo_interval = config.echo_interval_s
+            southbound.echo_timeout = config.echo_timeout_s
         if config.coalesce_routes:
             if hasattr(southbound, "on_idle"):
                 # route coalescing: the southbound's burst-drained edge

@@ -305,6 +305,19 @@ class EventFlowRemoved(Event):
 
 
 @dataclasses.dataclass
+class EventBarrierAck(Event):
+    """A datapath answered the OFPT_BARRIER_REQUEST terminating one of
+    its batched install spans (OpenFlow 1.0 §5.3.7: the switch has
+    finished processing everything sent before the barrier). The
+    recovery plane (control/recovery.py) treats it as the install's
+    end-to-end receipt: ack -> barrier_rtt_seconds sample; no ack
+    within Config.barrier_timeout_s -> anti-entropy resync."""
+
+    dpid: int
+    xid: int
+
+
+@dataclasses.dataclass
 class EventFDBRemove(Event):
     """Emitted when the router tears down a stale flow (no reference
     equivalent — the reference never removes flows, see SURVEY §2)."""

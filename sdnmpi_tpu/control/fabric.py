@@ -17,6 +17,7 @@ import logging
 from typing import Optional
 
 from sdnmpi_tpu.control.events import (
+    EventBarrierAck,
     EventDatapathDown,
     EventDatapathUp,
     EventHostAdd,
@@ -29,6 +30,7 @@ from sdnmpi_tpu.control.events import (
     EventSwitchLeave,
     EventTopologyChanged,
 )
+from sdnmpi_tpu.control.recovery import InstallVerdict
 from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
 from sdnmpi_tpu.protocol import openflow as of
 from sdnmpi_tpu.utils.metrics import REGISTRY
@@ -142,6 +144,11 @@ class SimSwitch:
         self.dpid = dpid
         self.ports: dict[int, SimPort] = {}
         self.flow_table: list[_FlowEntry] = []
+        #: match -> entries with that exact match (Match is frozen, so
+        #: hashable): O(1) ADD-replace and DELETE lookups instead of a
+        #: full-table dataclass-eq scan per FlowMod — reconciliation
+        #: re-drives whole desired sets, so installs dominate the sim
+        self._by_match: dict[of.Match, list[_FlowEntry]] = {}
         self.block_table: list[_BlockSetEntry] = []
         self.local_delivered: list[of.Packet] = []  # OFPP_LOCAL sink
         #: packets parked switch-side while the controller decides
@@ -167,22 +174,60 @@ class SimSwitch:
 
     def flow_mod(self, mod: of.FlowMod) -> None:
         if mod.command == of.OFPFC_ADD:
+            # OF 1.0 §4.6: an ADD whose match+priority equal an existing
+            # entry REPLACES it (counters reset). This is what makes
+            # reconciliation idempotent: the recovery plane can re-drive
+            # a desired set over a half-installed switch without
+            # accumulating duplicate entries.
+            bucket = self._by_match.setdefault(mod.match, [])
+            old = next(
+                (e for e in bucket if e.priority == mod.priority), None
+            )
+            if old is not None:
+                bucket.remove(old)
+                self.flow_table.remove(old)
             self._seq += 1
             now = self.fabric.now
-            self.flow_table.append(
-                _FlowEntry(
-                    mod.priority, mod.match, mod.actions, self._seq,
-                    idle_timeout=mod.idle_timeout,
-                    hard_timeout=mod.hard_timeout,
-                    installed_at=now, last_hit=now,
-                )
+            entry = _FlowEntry(
+                mod.priority, mod.match, mod.actions, self._seq,
+                idle_timeout=mod.idle_timeout,
+                hard_timeout=mod.hard_timeout,
+                installed_at=now, last_hit=now,
             )
+            bucket.append(entry)
+            self.flow_table.append(entry)
             # highest priority first; earlier install wins ties
             self.flow_table.sort(key=lambda e: (-e.priority, e.seq))
         elif mod.command == of.OFPFC_DELETE:
-            self.flow_table = [e for e in self.flow_table if e.match != mod.match]
+            if mod.match == of.Match():
+                # all-wildcard non-strict DELETE: the OF 1.0 "wipe the
+                # table" idiom (every field wildcarded matches every
+                # entry) — the recovery plane's resync escalation
+                self.flow_table = []
+                self._by_match.clear()
+            else:
+                doomed = self._by_match.pop(mod.match, None)
+                if doomed:
+                    doom_ids = {id(e) for e in doomed}
+                    self.flow_table = [
+                        e for e in self.flow_table if id(e) not in doom_ids
+                    ]
         else:
             raise ValueError(f"unsupported flow_mod command {mod.command}")
+
+    def drop_entries(self, doomed: set) -> None:
+        """Remove entries (by identity) from the table AND the match
+        index — the expiry sweep's bulk-removal seam (Fabric.tick)."""
+        self.flow_table = [e for e in self.flow_table if id(e) not in doomed]
+        for match in [
+            m for m, b in self._by_match.items()
+            if any(id(e) in doomed for e in b)
+        ]:
+            bucket = [e for e in self._by_match[match] if id(e) not in doomed]
+            if bucket:
+                self._by_match[match] = bucket
+            else:
+                del self._by_match[match]
 
     def add_block_entry(self, entry: _BlockSetEntry) -> None:
         self.block_table.append(entry)
@@ -351,6 +396,23 @@ class Fabric:
         #: simulation clock: advanced by tick(); stamps flow install /
         #: last-hit times for idle/hard expiry
         self.now: float = 0.0
+        #: fault-injection schedule (control/faults.FaultPlan) consulted
+        #: on every southbound send / stats pull; None = perfect fabric
+        self.faults = None
+        #: terminate each install span with a simulated barrier ack
+        #: (Config.install_barriers; the Controller overrides this) —
+        #: the sim's stand-in for OFPT_BARRIER_REQUEST/REPLY, through
+        #: the byte codec when wire=True
+        self.send_barriers: bool = True
+        #: dpid -> cabled (host_mac, port_no) of a crashed switch,
+        #: awaiting redial_switch (its links park in _dark_links)
+        self._crashed: dict[int, list[tuple[str, int]]] = {}
+        #: links whose restoration awaits BOTH endpoints redialing
+        self._dark_links: set[tuple[int, int, int, int]] = set()
+        #: dpid -> FIFO of deferred apply-thunks (a stalled TCP stream:
+        #: bytes queued but not yet processed by the switch; everything
+        #: behind the stall queues too, preserving per-connection order)
+        self._stall_q: dict[int, list] = {}
 
     def _next_xid(self) -> int:
         self._xid += 1
@@ -449,6 +511,66 @@ class Fabric:
             # revalidation runs once per topological change
             self.bus.publish(EventTopologyChanged())
 
+    def crash_switch(self, dpid: int) -> None:
+        """Kill a switch ungracefully: its OF session and links die and
+        its flow state is LOST — :meth:`redial_switch` brings it back
+        with an EMPTY table, exactly the scenario the recovery plane's
+        desired-state reconciliation exists for. Unflushed stalled
+        bytes die with the session; links are parked dark until both
+        endpoints are back."""
+        self._stall_q.pop(dpid, None)
+        self._crashed[dpid] = [
+            (mac, h.port_no) for mac, h in self.hosts.items()
+            if h.dpid == dpid
+        ]
+        self._dark_links.update(
+            l for l in self.links if dpid in (l[0], l[2])
+        )
+        self.remove_switch(dpid)
+
+    def redial_switch(self, dpid: int) -> None:
+        """A crashed switch reboots and redials: datapath-up + switch-
+        enter fire for a switch with an EMPTY flow table (the Router
+        still believed its flows were installed — PR 5's tentpole bug),
+        its hosts re-peer, and every dark link with both endpoints live
+        is restored."""
+        hosts = self._crashed.pop(dpid)
+        sw = self.add_switch(dpid)
+        for mac, port_no in hosts:
+            sw.port(port_no).peer = ("host", mac)
+            self._port_added(dpid)
+            if self.bus is not None and self.discovery == "direct":
+                self.bus.publish(EventHostAdd(self.hosts[mac].to_entity()))
+        for link in sorted(self._dark_links):
+            a, pa, b, pb = link
+            if a in self.switches and b in self.switches:
+                self._dark_links.discard(link)
+                self.add_link(a, pa, b, pb)
+        if self.bus is not None:
+            # one coalesced signal after the whole redial (links + hosts)
+            # so flow revalidation runs once over the healed graph
+            self.bus.publish(EventTopologyChanged())
+
+    def release_stalls(self, dpid: int | None = None) -> None:
+        """Flush stalled send streams: the queued bytes reach their
+        switch now, in FIFO order (barrier acks included). ``None``
+        releases every stalled stream (quiesce)."""
+        dpids = [dpid] if dpid is not None else sorted(self._stall_q)
+        for d in dpids:
+            for thunk in self._stall_q.pop(d, []):
+                thunk()
+
+    def _stalled(self, dpid: int, fault: str | None) -> bool:
+        """True when ``dpid``'s stream is (or just became) stalled —
+        subsequent sends must queue behind it to preserve the
+        per-connection FIFO a real TCP stream guarantees."""
+        if dpid in self._stall_q:
+            return True  # already stalled: everything queues behind
+        if fault == "stall":
+            self._stall_q[dpid] = []
+            return True
+        return False
+
     def remove_switch(self, dpid: int) -> None:
         sw = self.switches.pop(dpid)
         # datapath-down first so flow cleanup never targets the dead switch
@@ -489,7 +611,7 @@ class Fabric:
             if not expired:
                 continue
             doomed = {id(e) for e, _ in expired}
-            sw.flow_table = [e for e in sw.flow_table if id(e) not in doomed]
+            sw.drop_entries(doomed)
             for e, reason in expired:
                 self._flow_removed(dpid, e, reason)
         # time passed: any coalesced route lookups past their window
@@ -545,37 +667,88 @@ class Fabric:
 
     # -- southbound API used by the apps ----------------------------------
 
-    def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
+    def flow_mod(self, dpid: int, mod: of.FlowMod) -> bool:
+        """Returns the queued/dropped verdict, mirroring
+        OFSouthbound._send: False when the datapath is unknown or the
+        fault plan dropped the bytes."""
         sw = self.switches.get(dpid)
         if sw is None:  # datapath died between event and flow_mod
             log.debug("flow_mod to unknown dpid %s dropped", dpid)
-            return
+            return False
+        fault = self.faults.send_fault(dpid) if self.faults else None
+        if fault == "drop" or fault == "truncate":
+            # a truncated scalar mod is simply lost (nothing partial to
+            # apply at one-message granularity)
+            return False
         if self.wire:
             from sdnmpi_tpu.protocol import ofwire
 
             mod = ofwire.decode_flow_mod(
                 ofwire.encode_flow_mod(mod, xid=self._next_xid())
             )
+        if self._stalled(dpid, fault):
+            self._stall_q[dpid].append(lambda: sw.flow_mod(mod))
+            return True  # queued (a stalled stream is not a drop)
         sw.flow_mod(mod)
+        return True
 
-    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch) -> None:
+    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch):
         """Per-switch FlowMod burst (see flow_mods_window)."""
         import numpy as np
 
-        self.flow_mods_window(np.full(len(batch), dpid, np.int64), batch)
+        return self.flow_mods_window(
+            np.full(len(batch), dpid, np.int64), batch
+        )
 
-    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> None:
+    def _ack_barrier(self, dpid: int):
+        """Simulate the barrier request/reply terminating one switch's
+        span: returns ``(xid, publish_thunk | None)``. The thunk fires
+        the EventBarrierAck (immediately for a live stream, deferred
+        for a stalled one); None means the fault plan lost the reply —
+        the request was still sent, so the caller records the pending
+        barrier that will time out into an anti-entropy resync."""
+        xid = self._next_xid()
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            # round-trip request and reply through the byte codec, as
+            # every other wire-mode exchange does
+            xid = ofwire.decode_barrier_reply(
+                ofwire.encode_barrier_reply(
+                    ofwire.peek_header(
+                        ofwire.encode_barrier_request(xid)
+                    )[2]
+                )
+            )
+        if self.faults is not None and self.faults.ack_fault(dpid):
+            return xid, None  # install applied; the receipt was lost
+        bus = self.bus
+        return xid, (lambda: bus.publish(EventBarrierAck(dpid, xid))
+                     if bus is not None else None)
+
+    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> InstallVerdict:
         """A whole window's FlowMods across switches (``dpids`` is the
         [N] per-row switch id — the pipelined install plane's unit of
         transfer). With ``wire=True`` the window round-trips through
         ONE batched encode and the scalar per-message decoder over each
         row's byte span — proving the exact bytes a real switch would
         receive from OFSouthbound.flow_mods_window; otherwise the
-        scalar twins apply directly. Unknown dpids are skipped like
-        flow_mod's dead-datapath case."""
+        scalar twins apply directly. Unknown dpids are dropped like
+        flow_mod's dead-datapath case.
+
+        Returns the same :class:`InstallVerdict` contract as
+        ``OFSouthbound.flow_mods_window`` — per-switch queued/dropped
+        spans plus simulated barrier acks — with the fault plan
+        injecting dropped/stalled/truncated spans and lost acks."""
         import numpy as np
 
+        from sdnmpi_tpu.utils.arrays import group_spans
+
         dpids = np.asarray(dpids)
+        verdict = InstallVerdict()
+        if len(batch) == 0:
+            return verdict
+        blob = offsets = None
         if self.wire:
             from sdnmpi_tpu.protocol import ofwire
 
@@ -586,21 +759,63 @@ class Fabric:
             # same instrument the real southbound records, so wire-mode
             # sims exercise the telemetry plane end to end
             _m_encode_bytes.inc(len(blob))
-            for i in range(len(dpids)):
-                sw = self.switches.get(int(dpids[i]))
-                if sw is None:
-                    log.debug("flow_mods_window row for unknown dpid dropped")
-                    continue
-                sw.flow_mod(ofwire.decode_flow_mod(
-                    blob[int(offsets[i]) : int(offsets[i + 1])]
-                ))
-            return
-        for dpid, mod in zip(dpids, batch.to_flow_mods()):
-            sw = self.switches.get(int(dpid))
+        mods = None if self.wire else list(batch.to_flow_mods())
+        for lo, hi in group_spans(dpids):
+            dpid = int(dpids[lo])
+            sw = self.switches.get(dpid)
             if sw is None:
-                log.debug("flow_mods_window row for unknown dpid dropped")
+                log.debug("flow_mods_window span for unknown dpid dropped")
+                verdict.dropped.append(dpid)
                 continue
-            sw.flow_mod(mod)
+            fault = self.faults.send_fault(dpid) if self.faults else None
+            if fault == "drop":
+                verdict.dropped.append(dpid)
+                continue
+            end = hi
+            if fault == "truncate":
+                # the span's last TCP segment died mid-frame: the first
+                # half of the messages applied, the tail is lost — the
+                # partial-install case only the barrier/retry machinery
+                # can detect and repair
+                end = lo + max(0, (hi - lo) // 2)
+            if self.wire:
+                from sdnmpi_tpu.protocol import ofwire
+
+                span_mods = [
+                    ofwire.decode_flow_mod(
+                        blob[int(offsets[i]) : int(offsets[i + 1])]
+                    )
+                    for i in range(lo, end)
+                ]
+            else:
+                span_mods = mods[lo:end]
+            if self._stalled(dpid, fault):
+                q = self._stall_q[dpid]
+                q.extend(
+                    (lambda s=sw, m=m: s.flow_mod(m)) for m in span_mods
+                )
+                if fault == "truncate":
+                    verdict.dropped.append(dpid)
+                    continue
+                if self.send_barriers:
+                    xid, thunk = self._ack_barrier(dpid)
+                    verdict.barriers.append((dpid, xid))
+                    if thunk is not None:
+                        q.append(thunk)  # the ack drains behind the span
+                verdict.sent.append(dpid)
+                continue
+            for m in span_mods:
+                sw.flow_mod(m)
+            if fault == "truncate":
+                verdict.dropped.append(dpid)
+                continue
+            if self.send_barriers:
+                xid, thunk = self._ack_barrier(dpid)
+                verdict.barriers.append((dpid, xid))
+                if thunk is not None:
+                    thunk()
+            verdict.sent.append(dpid)
+        return verdict
 
     def flow_block_set(self, block: of.FlowBlockSet) -> None:
         """Install a whole collective's flows: partition the (sub-flow,
@@ -643,7 +858,10 @@ class Fabric:
             sw.remove_blocks(cookie)
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
-        sw = self.switches[dpid]
+        sw = self.switches.get(dpid)
+        if sw is None:  # datapath died between packet-in and reply
+            log.debug("packet_out to unknown dpid %s dropped", dpid)
+            return
         if self.wire:
             from sdnmpi_tpu.protocol import ofwire
 
@@ -664,6 +882,10 @@ class Fabric:
         sw.apply_actions(out.actions, pkt, out.in_port, hops=0)
 
     def port_stats(self, dpid: int) -> list[of.PortStatsEntry]:
+        if self.faults is not None and self.faults.stats_fault(dpid):
+            # delayed StatsReply: this pull returns nothing, exactly
+            # like OFSouthbound.port_stats before the reply lands
+            return []
         entries = self.switches[dpid].port_stats()
         if self.wire:
             from sdnmpi_tpu.protocol import ofwire

@@ -37,6 +37,13 @@ _m_passes = REGISTRY.counter(
 _m_samples = REGISTRY.counter(
     "monitor_port_samples_total", "per-port throughput samples published"
 )
+# shared with control/southbound.py (which discards the stale cached
+# StatsReply on a FEATURES_REPLY redial): both sites count the same
+# phenomenon — per-connection stats state outliving its connection
+_m_stale_stats = REGISTRY.counter(
+    "monitor_stale_stats_total",
+    "stale cached port-stats state discarded when a datapath redialed",
+)
 
 
 @dataclasses.dataclass
@@ -69,7 +76,15 @@ class Monitor:
 
     def _datapath_up(self, event: ev.EventDatapathUp) -> None:
         self.datapaths.add(event.dpid)
-        self.datapath_stats.setdefault(event.dpid, {})
+        if self.datapath_stats.get(event.dpid):
+            # an Up without a Down in between is a redial race (or a
+            # recovery-plane resync): the switch's counters restarted
+            # from zero, so the old baselines would differentiate into
+            # negative garbage — re-baseline from scratch
+            _m_stale_stats.inc()
+            self.datapath_stats[event.dpid] = {}
+        else:
+            self.datapath_stats.setdefault(event.dpid, {})
 
     def _datapath_down(self, event: ev.EventDatapathDown) -> None:
         self.datapaths.discard(event.dpid)

@@ -1,0 +1,367 @@
+"""Failure-domain recovery plane — desired state, acked installs, retries.
+
+PRs 1-4 made the route->install pipeline fast; every leg of it was still
+fire-and-forget (ISSUE 5): ``OFSouthbound._send`` returns a
+queued/dropped verdict but a dropped window was simply lost, a switch
+that crashed and redialed came back with an EMPTY flow table while the
+Router still believed its flows were installed, and a half-open TCP
+peer stayed "connected" forever. This module holds the bookkeeping that
+closes the loop; the Router drives it (control/router.py) and the
+southbounds feed it verdicts:
+
+- :class:`DesiredFlowStore` — per-switch record of what SHOULD be
+  installed (the Router's flow bookkeeping, minus the dedup role the
+  SwitchFDB keeps). It survives ``EventDatapathDown``, which is the
+  whole point: on ``EventDatapathUp`` for a known dpid the Router
+  reconciles the returning switch against it, and the periodic
+  anti-entropy pass re-drives switches whose window sends were dropped.
+- :class:`InstallVerdict` — what one batched southbound send actually
+  did: which switches got their whole byte span queued, which dropped
+  it, and the OFPT_BARRIER_REQUEST xids terminating each switch's span
+  (protocol/ofwire.py; the ack is the install's end-to-end receipt).
+- :class:`RecoveryPlane` — pending-barrier table (ack -> RTT histogram,
+  no ack -> resync), and the bounded per-switch retry queue with
+  exponential backoff + seeded jitter (``Config.install_retry_max``,
+  ``Config.install_retry_backoff_s``). Exhausted retries escalate to a
+  full datapath resync (wipe + re-drive) rather than silently diverge.
+
+DeltaPath (PAPERS.md) frames failure recovery as incremental repair;
+this is the control-plane twin of that idea: recovery re-drives only
+the failed switch's desired set through the PR-3 batched window path,
+never the whole fabric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+
+from sdnmpi_tpu.utils.metrics import LATENCY_BUCKETS_S, REGISTRY
+
+# -- recovery telemetry (first-class citizens of the PR-4 registry) -------
+_m_reconcile_flows = REGISTRY.counter(
+    "reconcile_flows_total",
+    "desired flows re-driven to a switch by the reconciler",
+)
+_m_reconcile_passes = REGISTRY.counter(
+    "reconcile_passes_total",
+    "per-switch reconciliation passes (datapath-up + anti-entropy)",
+)
+_m_retries = REGISTRY.counter(
+    "install_retries_total",
+    "retry-queue re-drives of dropped/un-acked install windows",
+)
+_m_giveups = REGISTRY.counter(
+    "install_retry_giveups_total",
+    "switches whose bounded retries exhausted (escalated to resync)",
+)
+_m_resyncs = REGISTRY.counter(
+    "install_resyncs_total",
+    "full datapath resyncs (table wipe + state re-drive) after retry "
+    "exhaustion",
+)
+_m_barrier_rtt = REGISTRY.histogram(
+    "barrier_rtt_seconds", LATENCY_BUCKETS_S,
+    "install window send -> OFPT_BARRIER_REPLY round-trip",
+)
+_m_barrier_timeouts = REGISTRY.counter(
+    "barrier_timeouts_total",
+    "install windows whose barrier ack never arrived in time",
+)
+_m_pending_barriers = REGISTRY.gauge(
+    "barriers_pending", "install windows awaiting their barrier ack"
+)
+_m_desired_flows = REGISTRY.gauge(
+    "desired_flows", "flows in the desired-state store across all switches"
+)
+# registered here (not only in control/southbound.py, whose incrementing
+# site lives beside the echo keepalive) so the family is present in
+# every controller's exposition — a sim-fabric deployment's dashboards
+# must not change shape when it moves to real TCP switches
+REGISTRY.counter(
+    "echo_timeouts_total",
+    "half-open datapaths aborted by the controller-side echo keepalive",
+)
+
+#: early barrier acks kept for matching (the simulated Fabric acks
+#: synchronously, BEFORE the caller can register the pending barrier)
+_EARLY_ACK_CAP = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowSpec:
+    """What one desired flow installs beyond its (src, dst) match: the
+    output port and the optional last-hop dl_dst rewrite (MPI virtual ->
+    real MAC). Priority/timeouts are uniform per Config, so the store
+    does not repeat them per row."""
+
+    out_port: int
+    rewrite: str | None = None
+
+
+@dataclasses.dataclass
+class InstallVerdict:
+    """Outcome of one batched southbound send (see module docstring).
+
+    ``sent``/``dropped`` are dpids; a dpid appears in exactly one of
+    them per send. ``barriers`` is ``[(dpid, xid), ...]`` — one
+    OFPT_BARRIER_REQUEST terminates each successfully queued span when
+    barriers are enabled, and its ack (EventBarrierAck) is the
+    end-to-end receipt the RecoveryPlane times out on."""
+
+    sent: list = dataclasses.field(default_factory=list)
+    dropped: list = dataclasses.field(default_factory=list)
+    barriers: list = dataclasses.field(default_factory=list)
+
+
+class DesiredFlowStore:
+    """dpid -> (src, dst) -> FlowSpec: what SHOULD be installed.
+
+    Deliberately NOT cleared on datapath down — a crashed switch's
+    desired set is exactly what the reconciler re-drives when it
+    redials. Rows leave only through intentional teardown (revalidation
+    re-routes, rank exits, switch-side expiry)."""
+
+    def __init__(self) -> None:
+        self.flows: dict[int, dict[tuple[str, str], FlowSpec]] = {}
+        self._count = 0
+
+    def record(
+        self, dpid: int, src: str, dst: str, out_port: int,
+        rewrite: str | None = None,
+    ) -> None:
+        table = self.flows.setdefault(dpid, {})
+        if (src, dst) not in table:
+            self._count += 1
+        table[(src, dst)] = FlowSpec(int(out_port), rewrite)
+        _m_desired_flows.set(self._count)
+
+    def remove(self, dpid: int, src: str, dst: str) -> None:
+        table = self.flows.get(dpid)
+        if table is None or table.pop((src, dst), None) is None:
+            return
+        self._count -= 1
+        if not table:
+            del self.flows[dpid]
+        _m_desired_flows.set(self._count)
+
+    def has(self, dpid: int, src: str, dst: str) -> bool:
+        return (src, dst) in self.flows.get(dpid, {})
+
+    def entries_for(self, dpid: int) -> list[tuple[str, str, FlowSpec]]:
+        """This switch's desired rows in deterministic order (the
+        reconciler's unit of work; sorted so a reconcile install is
+        byte-identical run to run)."""
+        table = self.flows.get(dpid, {})
+        return [(s, d, spec) for (s, d), spec in sorted(table.items())]
+
+    def total(self) -> int:
+        return self._count
+
+
+@dataclasses.dataclass
+class _Retry:
+    """One switch's pending re-drive: ``resync`` re-pushes the whole
+    desired set; ``deletes`` re-drives specific dropped teardowns."""
+
+    due: float = 0.0
+    resync: bool = False
+    deletes: set = dataclasses.field(default_factory=set)
+
+
+class RecoveryPlane:
+    """Retry/backoff + barrier-ack bookkeeping (see module docstring).
+
+    Single-threaded by bus discipline, like every control-plane store.
+    ``now`` parameters take ``time.monotonic()`` values; tests inject
+    their own clock."""
+
+    def __init__(self, config, seed: int = 0) -> None:
+        self.config = config
+        self.desired = DesiredFlowStore()
+        self._rng = random.Random(seed)
+        self._retries: dict[int, _Retry] = {}
+        #: (dpid, xid) -> (send time, delete rows | None) of barriers
+        #: awaiting their ack — DELETE windows carry their rows so an
+        #: expiry re-drives the teardown itself, not just the ADD set
+        self._pending: dict[tuple[int, int], tuple] = {}
+        #: dpid -> teardown rows whose delivery is unconfirmed and whose
+        #: switch went away before the retry could run. Survives the
+        #: down edge on purpose: a TCP-bounced switch KEEPS its flow
+        #: table, so reconcile-on-up must re-drive these deletes or the
+        #: stale flows forward forever (the desired store alone only
+        #: covers the ADD side).
+        self._lost_deletes: dict[int, set] = {}
+        #: acks that arrived before their send registered (sim fabrics
+        #: ack synchronously inside flow_mods_window): (dpid, xid) -> t
+        self._early_acks: dict[tuple[int, int], float] = {}
+        #: consecutive failed re-drives per dpid (cleared on success)
+        self._attempts: dict[int, int] = {}
+        #: escalation hook fired when a dropped send cannot be queued
+        #: because the dpid's bounded retries are already exhausted —
+        #: the Router points this at its wipe-and-resync. Without it a
+        #: drop landing AFTER exhaustion would be given up silently
+        #: (found by the seeded chaos soak: a revalidation reinstall
+        #: dropped post-exhaustion stayed missing through quiesce).
+        self.on_exhausted = None
+
+    # -- send outcomes ----------------------------------------------------
+
+    def note_send(
+        self, verdict, delete_rows=None, now: float | None = None,
+        reschedule: bool = True,
+    ) -> None:
+        """Digest one batched send's outcome: register its barriers and
+        (when ``reschedule``) queue retries for its dropped switches.
+        ``delete_rows`` maps dpid -> set[(src, dst)] for DELETE windows,
+        so a dropped teardown re-drives as a teardown, not a resync.
+        ``verdict`` may be None (duck-typed southbounds without the
+        verdict contract) — a no-op, the fire-and-forget legacy."""
+        if verdict is None:
+            return
+        now = time.monotonic() if now is None else now
+        for dpid, xid in verdict.barriers:
+            t_ack = self._early_acks.pop((dpid, xid), None)
+            if t_ack is not None:
+                _m_barrier_rtt.observe(max(0.0, t_ack - now))
+            else:
+                rows = None if delete_rows is None else delete_rows.get(dpid)
+                self._pending[(dpid, xid)] = (
+                    now, None if rows is None else frozenset(rows)
+                )
+        _m_pending_barriers.set(len(self._pending))
+        if not reschedule:
+            return
+        for dpid in verdict.dropped:
+            rows = None if delete_rows is None else delete_rows.get(dpid)
+            if (
+                not self.schedule(dpid, now, deletes=rows,
+                                  resync=rows is None)
+                and self.on_exhausted is not None
+            ):
+                self.on_exhausted(dpid)
+
+    def ack(self, dpid: int, xid: int, now: float | None = None) -> None:
+        """An OFPT_BARRIER_REPLY (EventBarrierAck) arrived."""
+        now = time.monotonic() if now is None else now
+        entry = self._pending.pop((dpid, xid), None)
+        if entry is None:
+            # sim fabrics ack before note_send registers the barrier;
+            # park it for the imminent match (bounded, FIFO-evicted)
+            self._early_acks[(dpid, xid)] = now
+            while len(self._early_acks) > _EARLY_ACK_CAP:
+                self._early_acks.pop(next(iter(self._early_acks)))
+            return
+        _m_barrier_rtt.observe(now - entry[0])
+        _m_pending_barriers.set(len(self._pending))
+
+    def expire_barriers(self, now: float, timeout_s: float) -> dict:
+        """Pop every pending barrier older than ``timeout_s``. Returns
+        ``{dpid: (delete_rows, resync)}``: an expired DELETE window
+        re-drives its own rows; an expired install window (rows None)
+        asks for a desired-set resync — both may be true when several
+        windows expired together."""
+        expired = [k for k, (t0, _rows) in self._pending.items()
+                   if now - t0 >= timeout_s]
+        stale: dict[int, tuple[set, bool]] = {}
+        for key in expired:
+            _t0, rows = self._pending.pop(key)
+            _m_barrier_timeouts.inc()
+            deletes, resync = stale.get(key[0], (set(), False))
+            if rows is None:
+                resync = True
+            else:
+                deletes = deletes | set(rows)
+            stale[key[0]] = (deletes, resync)
+        if expired:
+            _m_pending_barriers.set(len(self._pending))
+        return stale
+
+    def stash_lost_deletes(self, dpid: int, rows) -> None:
+        """Park teardown rows whose switch is unreachable; the next
+        reconcile drains them (see _lost_deletes)."""
+        if rows:
+            self._lost_deletes.setdefault(dpid, set()).update(rows)
+
+    def take_lost_deletes(self, dpid: int) -> set:
+        return self._lost_deletes.pop(dpid, set())
+
+    # -- retry queue ------------------------------------------------------
+
+    def schedule(
+        self, dpid: int, now: float, deletes=None, resync: bool = True,
+    ) -> bool:
+        """Queue a re-drive for ``dpid`` with exponential backoff +
+        jitter. Returns False when the bounded retries are exhausted —
+        the caller escalates to a full resync (and the attempt clock
+        restarts)."""
+        attempt = self._attempts.get(dpid, 0) + 1
+        if attempt > self.config.install_retry_max:
+            _m_giveups.inc()
+            self._attempts.pop(dpid, None)
+            self._retries.pop(dpid, None)
+            return False
+        self._attempts[dpid] = attempt
+        retry = self._retries.setdefault(dpid, _Retry())
+        if deletes:
+            retry.deletes |= set(deletes)
+        if resync:
+            retry.resync = True
+        backoff = (
+            self.config.install_retry_backoff_s
+            * (2 ** (attempt - 1))
+            * (1.0 + 0.25 * self._rng.random())
+        )
+        retry.due = now + backoff
+        return True
+
+    def pop_due(self, now: float) -> list[tuple[int, _Retry]]:
+        """Remove and return every retry whose backoff elapsed. The
+        attempt count stays on the books until :meth:`succeed` — a
+        re-drive that fails again resumes the backoff curve where it
+        left off."""
+        due = [(d, r) for d, r in self._retries.items() if r.due <= now]
+        for dpid, _ in due:
+            del self._retries[dpid]
+        return due
+
+    def succeed(self, dpid: int) -> None:
+        """A re-drive (or reconcile) for ``dpid`` went through cleanly:
+        its failure streak is over."""
+        self._attempts.pop(dpid, None)
+
+    def forget(self, dpid: int) -> None:
+        """Datapath down: its pending barriers will never ack and its
+        queued retries are moot — reconcile-on-up re-drives from the
+        desired store, which this deliberately does NOT touch.
+        Unconfirmed TEARDOWN rows are parked in the lost-delete ledger
+        instead of dropped: a bounced switch keeps its flow table, and
+        only re-driving the deletes can clear the stale entries."""
+        retry = self._retries.pop(dpid, None)
+        if retry is not None:
+            self.stash_lost_deletes(dpid, retry.deletes)
+        self._attempts.pop(dpid, None)
+        stale = [k for k in self._pending if k[0] == dpid]
+        for key in stale:
+            _t0, rows = self._pending.pop(key)
+            if rows:
+                self.stash_lost_deletes(dpid, rows)
+        if stale:
+            _m_pending_barriers.set(len(self._pending))
+
+    # -- metric seams (the Router counts through these so the counters
+    # live beside the machinery they describe) ----------------------------
+
+    @staticmethod
+    def note_reconcile(n_flows: int) -> None:
+        _m_reconcile_passes.inc()
+        _m_reconcile_flows.inc(n_flows)
+
+    @staticmethod
+    def note_retry() -> None:
+        _m_retries.inc()
+
+    @staticmethod
+    def note_resync() -> None:
+        _m_resyncs.inc()

@@ -26,6 +26,7 @@ import time
 from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
 from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
+from sdnmpi_tpu.control.recovery import InstallVerdict, RecoveryPlane
 from sdnmpi_tpu.core.collective_table import CollectiveInstall, CollectiveTable
 from sdnmpi_tpu.core.switch_fdb import SwitchFDB
 from sdnmpi_tpu.protocol import openflow as of
@@ -167,9 +168,17 @@ class Router:
         #: whose installed paths touch a dirtied switch.
         self._reval_version: int | None = None
         self._reval_util_epoch: int = -1
+        #: failure-domain recovery plane (ISSUE 5): desired-flow store,
+        #: pending-barrier table, bounded retry queue. The store is
+        #: always maintained (it is just bookkeeping); the reconcile /
+        #: retry / anti-entropy behaviors gate on Config.recovery_plane.
+        self.recovery = RecoveryPlane(config)
+        self.recovery.on_exhausted = self._resync_datapath
 
-        bus.subscribe(ev.EventDatapathUp, lambda e: self.dps.add(e.dpid))
+        bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
+        bus.subscribe(ev.EventBarrierAck, lambda e: self.recovery.ack(e.dpid, e.xid))
+        bus.subscribe(ev.EventStatsFlush, lambda e: self.recovery_tick())
         bus.subscribe(ev.EventPacketIn, self._packet_in)
         bus.subscribe(ev.EventTopologyChanged, lambda e: self._revalidate_flows())
         bus.subscribe(ev.EventProcessDelete, self._process_delete)
@@ -186,7 +195,7 @@ class Router:
         dst: str,
         out_port: int,
         actions: tuple[of.Action, ...] = (),
-    ) -> None:
+    ):
         # match on (dl_src, dl_dst) exactly like the reference
         # (router.py:49-62); for MPI flows dst is the *virtual* MAC so the
         # whole path forwards on it and only the last hop rewrites
@@ -197,9 +206,48 @@ class Router:
             idle_timeout=self.config.flow_idle_timeout,
             hard_timeout=self.config.flow_hard_timeout,
         )
-        self.southbound.flow_mod(dpid, mod)
+        return self.southbound.flow_mod(dpid, mod)
 
-    def _del_flow(self, dpid: int, src: str, dst: str) -> None:
+    def _send_window(self, kd, burst: of.FlowModBatch):
+        """Ship one dpid-grouped FlowModBatch through the richest send
+        entry point the southbound offers (whole-window byte spans >
+        per-switch batches). Returns the southbound's
+        :class:`InstallVerdict`, or None for duck-typed southbounds
+        without the verdict contract (the fire-and-forget legacy, which
+        the recovery plane treats as a no-op)."""
+        window_send = getattr(self.southbound, "flow_mods_window", None)
+        if window_send is not None:
+            # one batched encode for the whole window; each switch
+            # gets its contiguous byte span (southbound slices it)
+            return window_send(kd, burst)
+        from sdnmpi_tpu.utils.arrays import group_spans
+
+        verdict = None
+        for lo, hi in group_spans(kd):
+            v = self.southbound.flow_mods_batch(
+                int(kd[lo]), of.FlowModBatch(
+                    src=burst.src[lo:hi],
+                    dst=burst.dst[lo:hi],
+                    out_port=burst.out_port[lo:hi],
+                    rewrite=(
+                        None if burst.rewrite is None
+                        else burst.rewrite[lo:hi]
+                    ),
+                    priority=burst.priority,
+                    idle_timeout=burst.idle_timeout,
+                    hard_timeout=burst.hard_timeout,
+                    command=burst.command,
+                )
+            )
+            if isinstance(v, InstallVerdict):
+                if verdict is None:
+                    verdict = InstallVerdict()
+                verdict.sent += v.sent
+                verdict.dropped += v.dropped
+                verdict.barriers += v.barriers
+        return verdict
+
+    def _del_flow(self, dpid: int, src: str, dst: str):
         mod = of.FlowMod(
             match=of.Match(dl_src=src, dl_dst=dst),
             actions=(),
@@ -207,7 +255,7 @@ class Router:
             command=of.OFPFC_DELETE,
         )
         _m_flows_deleted.inc()
-        self.southbound.flow_mod(dpid, mod)
+        return self.southbound.flow_mod(dpid, mod)
 
     def _del_flows_window(self, rows: list[tuple[int, str, str]]) -> None:
         """Tear down a burst of (dpid, src, dst) exact matches through
@@ -223,6 +271,10 @@ class Router:
         scalar leg); ``pipelined_install=False`` or a batchless
         southbound falls back to scalar ``_del_flow`` per row — the
         differential escape hatch, byte-identical on the wire."""
+        # the rows leave the DESIRED store unconditionally (dead-dpid
+        # rows too: a crashed switch's redial must not resurrect them)
+        for dpid, src, dst in rows:
+            self.recovery.desired.remove(dpid, src, dst)
         live = [r for r in rows if r[0] in self.dps]
         if not live:
             return
@@ -230,8 +282,15 @@ class Router:
             not self.config.pipelined_install
             or not hasattr(self.southbound, "flow_mods_batch")
         ):
+            failed: dict[int, set] = {}
             for dpid, src, dst in live:
-                self._del_flow(dpid, src, dst)
+                if self._del_flow(dpid, src, dst) is False:
+                    failed.setdefault(dpid, set()).add((src, dst))
+            if failed and self.config.recovery_plane:
+                self.recovery.note_send(
+                    InstallVerdict(dropped=sorted(failed)),
+                    delete_rows=failed,
+                )
             return
         import numpy as np
 
@@ -250,23 +309,14 @@ class Router:
         )
         _m_flows_deleted.inc(len(live))
         _m_teardown_batches.inc()
-        window_send = getattr(self.southbound, "flow_mods_window", None)
-        if window_send is not None:
-            window_send(kd, burst)
-        else:
-            from sdnmpi_tpu.utils.arrays import group_spans
-
-            for lo, hi in group_spans(kd):
-                self.southbound.flow_mods_batch(
-                    int(kd[lo]), of.FlowModBatch(
-                        src=burst.src[lo:hi],
-                        dst=burst.dst[lo:hi],
-                        out_port=burst.out_port[lo:hi],
-                        rewrite=None,
-                        priority=burst.priority,
-                        command=of.OFPFC_DELETE,
-                    )
-                )
+        verdict = self._send_window(kd, burst)
+        if self.config.recovery_plane:
+            # a dropped teardown re-drives as a teardown (not a resync):
+            # the retry entry carries the exact (src, dst) rows
+            delete_rows: dict[int, set] = {}
+            for dpid, src, dst in live:
+                delete_rows.setdefault(dpid, set()).add((src, dst))
+            self.recovery.note_send(verdict, delete_rows=delete_rows)
 
     def _add_flows_for_path(
         self,
@@ -276,6 +326,7 @@ class Router:
         true_dst: str | None = None,
     ) -> None:
         """Install one flow per hop (reference: router.py:83-104)."""
+        failed: list[int] = []
         for idx, (dpid, out_port) in enumerate(fdb):
             if self.fdb.exists(dpid, src, dst):
                 continue
@@ -288,14 +339,25 @@ class Router:
             _m_flows_installed.inc()
             self.bus.publish(ev.EventFDBUpdate(dpid, src, dst, out_port))
 
-            if true_dst and idx == len(fdb) - 1:
+            last = idx == len(fdb) - 1
+            rewrite = true_dst if (true_dst and last) else None
+            self.recovery.desired.record(dpid, src, dst, out_port, rewrite)
+            if rewrite:
                 # virtual -> real MAC rewrite on the final hop
                 # (reference: router.py:98-102)
-                self._add_flow(
+                ok = self._add_flow(
                     dpid, src, dst, out_port, (of.ActionSetDlDst(true_dst),)
                 )
             else:
-                self._add_flow(dpid, src, dst, out_port)
+                ok = self._add_flow(dpid, src, dst, out_port)
+            if ok is False:
+                failed.append(dpid)
+        if failed and self.config.recovery_plane:
+            # dropped scalar installs enter the same bounded retry queue
+            # the batched windows use (resync re-drives the desired set)
+            self.recovery.note_send(
+                InstallVerdict(dropped=sorted(set(failed)))
+            )
 
     def _send_packet_out(
         self,
@@ -635,7 +697,7 @@ class Router:
                     self._add_flows_for_path(wr.fdb(k), src, dst, true_dst)
             return routable
 
-        from sdnmpi_tpu.utils.mac import mac_to_int, macs_to_ints
+        from sdnmpi_tpu.utils.mac import int_to_mac, mac_to_int, macs_to_ints
 
         f, l = wr.hop_dpid.shape
         mask = np.arange(l)[None, :] < ln[:, None]
@@ -667,6 +729,10 @@ class Router:
                 continue
             p = int(port[i])
             self.fdb.update(d, src, dst, p)
+            rw = int(m_rew[i])
+            self.recovery.desired.record(
+                d, src, dst, p, int_to_mac(rw) if rw >= 0 else None
+            )
             self.bus.publish(ev.EventFDBUpdate(d, src, dst, p))
             keep[i] = True
         if keep.any():
@@ -687,26 +753,12 @@ class Router:
                 "southbound_send", n_rows=len(kd),
                 n_switches=int(np.count_nonzero(np.diff(kd)) + 1),
             )
-            window_send = getattr(self.southbound, "flow_mods_window", None)
-            if window_send is not None:
-                # one batched encode for the whole window; each switch
-                # gets its contiguous byte span (southbound slices it)
-                window_send(kd, burst)
-            else:
-                from sdnmpi_tpu.utils.arrays import group_spans
-
-                for lo, hi in group_spans(kd):
-                    self.southbound.flow_mods_batch(
-                        int(kd[lo]), of.FlowModBatch(
-                            src=burst.src[lo:hi],
-                            dst=burst.dst[lo:hi],
-                            out_port=burst.out_port[lo:hi],
-                            rewrite=burst.rewrite[lo:hi],
-                            priority=burst.priority,
-                            idle_timeout=burst.idle_timeout,
-                            hard_timeout=burst.hard_timeout,
-                        )
-                    )
+            verdict = self._send_window(kd, burst)
+            if self.config.recovery_plane:
+                # dropped spans enter the bounded retry queue; barrier
+                # xids arm the pending-ack table (barrier_rtt_seconds /
+                # anti-entropy on timeout)
+                self.recovery.note_send(verdict)
             ssp.end()
         return routable
 
@@ -957,6 +1009,9 @@ class Router:
             event.dpid, src, dst, event.reason, event.packet_count,
         )
         self.fdb.remove(event.dpid, src, dst)
+        # the switch expired it on purpose: it is no longer desired
+        # either (a reconcile must not resurrect a timed-out flow)
+        self.recovery.desired.remove(event.dpid, src, dst)
         self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
 
     def _datapath_down(self, event: ev.EventDatapathDown) -> None:
@@ -964,6 +1019,214 @@ class Router:
         for (src, dst), _ in list(self.fdb.fdb.get(event.dpid, {}).items()):
             self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
         self.fdb.remove_switch(event.dpid)
+        # pending barriers/retries are moot; the DESIRED set survives —
+        # it is exactly what the reconciler re-drives on redial
+        self.recovery.forget(event.dpid)
+
+    # -- failure-domain recovery (ISSUE 5; no reference equivalent) --------
+
+    def _datapath_up(self, event: ev.EventDatapathUp) -> None:
+        self.dps.add(event.dpid)
+        if self.config.recovery_plane:
+            self._reconcile_datapath(event.dpid)
+
+    def _reconcile_datapath(self, dpid: int) -> None:
+        """Re-drive a returning datapath's entire desired flow set.
+
+        A switch that crashed and redialed comes back with an EMPTY
+        flow table; one that merely lost its TCP session kept its flows
+        (re-driving is then idempotent — OF 1.0 ADD replaces an
+        identical match+priority entry). Either way the switch ends up
+        byte-identical to a fresh install of the desired set, through
+        the same batched ``flow_mods_window`` path, without waiting for
+        packet-ins to fault the flows back in one at a time. Teardowns
+        that were unconfirmed when the switch went away re-drive too
+        (the lost-delete ledger): the bounced-switch case where stale
+        flows survived in the kept table."""
+        rows = self.recovery.desired.entries_for(dpid)
+        self.recovery.forget(dpid)  # a redial obsoletes prior bookkeeping
+        # forget() parked any unconfirmed teardowns; rows re-desired
+        # since are covered by the reinstall (ADD replaces the entry)
+        lost = [
+            (s, d) for (s, d) in sorted(self.recovery.take_lost_deletes(dpid))
+            if not self.recovery.desired.has(dpid, s, d)
+        ]
+        if (not rows and not lost) or dpid not in self.dps:
+            return
+        log.info(
+            "reconciling datapath %#x: re-driving %d desired flows, "
+            "%d lost teardowns", dpid, len(rows), len(lost),
+        )
+        if lost:
+            verdict = self._send_deletes(dpid, lost)
+            self.recovery.note_send(
+                verdict, delete_rows={dpid: set(lost)}
+            )
+        if not rows:
+            return
+        # the down-edge cleared this switch's FDB rows; restore the
+        # bookkeeping the installs below re-create on the switch
+        for src, dst, spec in rows:
+            if not self.fdb.exists(dpid, src, dst):
+                self.fdb.update(dpid, src, dst, spec.out_port)
+                self.bus.publish(
+                    ev.EventFDBUpdate(dpid, src, dst, spec.out_port)
+                )
+        self.recovery.note_reconcile(len(rows))
+        verdict = self._send_desired(dpid, rows)
+        self.recovery.note_send(verdict)
+
+    def _send_deletes(self, dpid: int, rows) -> "InstallVerdict | None":
+        """Tear down ``rows`` (``[(src, dst), ...]``) on one switch —
+        the retry/reconcile twin of :meth:`_send_desired`, honoring the
+        same ``pipelined_install`` escape hatch and batchless-southbound
+        fallback as every other send site."""
+        if (
+            not self.config.pipelined_install
+            or not hasattr(self.southbound, "flow_mods_batch")
+        ):
+            ok = True
+            for src, dst in rows:
+                if self._del_flow(dpid, src, dst) is False:
+                    ok = False
+            return InstallVerdict(
+                sent=[dpid] if ok else [], dropped=[] if ok else [dpid]
+            )
+        import numpy as np
+
+        from sdnmpi_tpu.utils.mac import macs_to_ints
+
+        return self._send_window(
+            np.full(len(rows), dpid, np.int64),
+            of.FlowModBatch(
+                src=macs_to_ints([r[0] for r in rows]),
+                dst=macs_to_ints([r[1] for r in rows]),
+                out_port=np.zeros(len(rows), np.int32),
+                rewrite=None,
+                priority=self.config.priority_default,
+                command=of.OFPFC_DELETE,
+            ),
+        )
+
+    def _send_desired(self, dpid: int, rows) -> "InstallVerdict | None":
+        """Install ``rows`` (``[(src, dst, FlowSpec), ...]``) on one
+        switch through the batched window path; scalar fallback for the
+        ``pipelined_install=False`` escape hatch and batchless
+        southbounds."""
+        if (
+            not self.config.pipelined_install
+            or not hasattr(self.southbound, "flow_mods_batch")
+        ):
+            ok = True
+            for src, dst, spec in rows:
+                actions = (
+                    (of.ActionSetDlDst(spec.rewrite),) if spec.rewrite else ()
+                )
+                sent = self._add_flow(dpid, src, dst, spec.out_port, actions)
+                ok = ok and sent is not False
+            return InstallVerdict(
+                sent=[dpid] if ok else [], dropped=[] if ok else [dpid]
+            )
+        import numpy as np
+
+        from sdnmpi_tpu.utils.mac import mac_to_int, macs_to_ints
+
+        burst = of.FlowModBatch(
+            src=macs_to_ints([r[0] for r in rows]),
+            dst=macs_to_ints([r[1] for r in rows]),
+            out_port=np.array([r[2].out_port for r in rows], np.int32),
+            rewrite=np.array(
+                [mac_to_int(r[2].rewrite) if r[2].rewrite else -1
+                 for r in rows],
+                np.int64,
+            ),
+            priority=self.config.priority_default,
+            idle_timeout=self.config.flow_idle_timeout,
+            hard_timeout=self.config.flow_hard_timeout,
+        )
+        _m_flows_installed.inc(len(rows))
+        return self._send_window(np.full(len(rows), dpid, np.int64), burst)
+
+    def recovery_tick(self, now: float | None = None) -> None:
+        """One anti-entropy pass (per EventStatsFlush — the Monitor's
+        cadence, the same edge the utilization plane flushes on): expire
+        un-acked barriers into resync retries, then re-drive every due
+        retry. Bounded per switch by ``Config.install_retry_max``;
+        exhaustion escalates to a full resync (:meth:`_resync_datapath`)
+        instead of silent desired/installed divergence."""
+        if not self.config.recovery_plane:
+            return
+        now = time.monotonic() if now is None else now
+        for dpid, (rows, resync) in self.recovery.expire_barriers(
+            now, self.config.barrier_timeout_s
+        ).items():
+            # the window may or may not have applied — only a re-drive
+            # (of the delete rows for a teardown window, of the desired
+            # set otherwise) makes the switch's state known again
+            if not self.recovery.schedule(
+                dpid, now, deletes=rows, resync=resync
+            ):
+                self._resync_datapath(dpid)
+        for dpid, retry in self.recovery.pop_due(now):
+            if dpid not in self.dps:
+                # reconcile-on-up owns dead datapaths; unconfirmed
+                # teardowns park in the lost-delete ledger so a bounced
+                # switch that KEPT its table still sheds them
+                self.recovery.stash_lost_deletes(dpid, retry.deletes)
+                continue
+            self.recovery.note_retry()
+            ok = True
+            deletes = [
+                (s, d) for (s, d) in sorted(retry.deletes)
+                # a pair re-installed since its dropped teardown is
+                # covered by the reinstall (ADD replaced the entry);
+                # deleting it now would wipe the fresh flow
+                if not self.recovery.desired.has(dpid, s, d)
+            ]
+            if deletes:
+                verdict = self._send_deletes(dpid, deletes)
+                if verdict is not None:
+                    self.recovery.note_send(
+                        verdict, delete_rows={dpid: set(deletes)},
+                        reschedule=False,
+                    )
+                    ok = ok and dpid not in verdict.dropped
+            if retry.resync:
+                rows = self.recovery.desired.entries_for(dpid)
+                if rows:
+                    self.recovery.note_reconcile(len(rows))
+                    verdict = self._send_desired(dpid, rows)
+                    if verdict is not None:
+                        self.recovery.note_send(verdict, reschedule=False)
+                        ok = ok and dpid not in verdict.dropped
+            if ok:
+                self.recovery.succeed(dpid)
+            elif not self.recovery.schedule(
+                now=now, dpid=dpid, deletes=set(deletes),
+                resync=retry.resync,
+            ):
+                self._resync_datapath(dpid)
+
+    def _resync_datapath(self, dpid: int) -> None:
+        """Last-resort escalation after retry exhaustion: wipe the
+        switch's flow table with an all-wildcard OFPFC_DELETE (the OF
+        1.0 "forget everything" idiom) and republish EventDatapathUp so
+        EVERY app re-drives its per-switch state — the TopologyManager
+        its bootstrap flows, the ProcessManager its announcement trap,
+        this Router the desired set — exactly as on a redial. The
+        switch's state is then known-good again regardless of which
+        windows it lost."""
+        if dpid not in self.dps:
+            return
+        self.recovery.note_resync()
+        log.warning(
+            "datapath %#x: retries exhausted; wiping and resyncing", dpid
+        )
+        self.southbound.flow_mod(dpid, of.FlowMod(
+            match=of.Match(), actions=(), priority=0,
+            command=of.OFPFC_DELETE,
+        ))
+        self.bus.publish(ev.EventDatapathUp(dpid))
 
     def _effective_dst(self, dst: str) -> str | None:
         """The MAC a flow actually targets: for MPI flows the dst is a

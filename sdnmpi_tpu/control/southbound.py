@@ -40,6 +40,7 @@ import struct
 import numpy as np
 
 from sdnmpi_tpu.control.events import (
+    EventBarrierAck,
     EventDatapathDown,
     EventDatapathUp,
     EventFlowRemoved,
@@ -49,6 +50,7 @@ from sdnmpi_tpu.control.events import (
     EventSwitchEnter,
     EventSwitchLeave,
 )
+from sdnmpi_tpu.control.recovery import InstallVerdict
 from sdnmpi_tpu.core.topology_db import Port, Switch
 from sdnmpi_tpu.protocol import ofwire
 from sdnmpi_tpu.protocol import openflow as of
@@ -82,6 +84,14 @@ _m_slices = REGISTRY.counter(
     "southbound_install_slices_total",
     "install_highwater byte slices written by batched installs",
 )
+_m_echo_timeouts = REGISTRY.counter(
+    "echo_timeouts_total",
+    "half-open datapaths aborted by the controller-side echo keepalive",
+)
+_m_stale_stats = REGISTRY.counter(
+    "monitor_stale_stats_total",
+    "stale cached port-stats state discarded when a datapath redialed",
+)
 
 OFP_TCP_PORT = 6633
 
@@ -99,6 +109,19 @@ class OFSouthbound:
         self._stats: dict[int, list[of.PortStatsEntry]] = {}
         self._cookie_flows: dict[int, list] = {}
         self._xid = 0
+        #: dpid -> (xid, sent_at monotonic) of the outstanding echo
+        #: probe; a reply (any xid — liveness is liveness) clears it,
+        #: echo_timeout without one aborts the transport so the reader
+        #: loop exits and EventDatapathDown actually fires (the
+        #: half-open-peer kill the recovery plane relies on)
+        self._echo_pending: dict[int, tuple[int, float]] = {}
+        #: controller-side keepalive knobs (Config.echo_interval_s /
+        #: echo_timeout_s; the Controller overrides these)
+        self.echo_interval: float = 15.0
+        self.echo_timeout: float = 45.0
+        #: terminate each batched install span with a BARRIER_REQUEST
+        #: (Config.install_barriers; the Controller overrides this)
+        self.send_barriers: bool = True
         #: called after a connection's read burst fully drains — every
         #: complete frame of one TCP read has been dispatched and no
         #: partial frame remains unhandled in this slice. The same idle
@@ -168,6 +191,7 @@ class OFSouthbound:
                 del self._writers[dpid]
                 self._ports.pop(dpid, None)
                 self._stats.pop(dpid, None)
+                self._echo_pending.pop(dpid, None)
                 if self.bus is not None:
                     self.bus.publish(EventDatapathDown(dpid))
                     self.bus.publish(
@@ -231,6 +255,12 @@ class OFSouthbound:
         if msg_type == ofwire.OFPT_ECHO_REQUEST:
             writer.write(ofwire.encode_echo_reply(msg[8:], xid))
             return dpid
+        if msg_type == ofwire.OFPT_ECHO_REPLY:
+            # controller-side keepalive answered: the peer is live (any
+            # reply proves it — no need to match the probe's xid)
+            if dpid is not None:
+                self._echo_pending.pop(dpid, None)
+            return dpid
         if msg_type == ofwire.OFPT_FEATURES_REPLY:
             new_dpid, port_nos = ofwire.decode_features_reply(msg)
             stale = self._writers.get(new_dpid)
@@ -244,6 +274,15 @@ class OFSouthbound:
                     new_dpid,
                 )
                 stale.transport.abort()
+            # a redial is a NEW switch process: the previous
+            # connection's cached StatsReply and outstanding echo probe
+            # are stale. Without this, a dpid that disconnected between
+            # Monitor passes and redialed before the next StatsReply
+            # would serve the dead connection's counters (or, when its
+            # down-path cleanup raced the redial, nothing) forever.
+            if self._stats.pop(new_dpid, None) is not None:
+                _m_stale_stats.inc()
+            self._echo_pending.pop(new_dpid, None)
             self._writers[new_dpid] = writer
             self._ports[new_dpid] = set(port_nos)
             if self.bus is not None:
@@ -314,6 +353,11 @@ class OFSouthbound:
                 ))
         elif msg_type == ofwire.OFPT_STATS_REPLY:
             self._stats[dpid] = ofwire.decode_port_stats_reply(msg)
+        elif msg_type == ofwire.OFPT_BARRIER_REPLY:
+            # the end-to-end receipt of a batched install span: the
+            # switch has processed everything sent before the barrier
+            if self.bus is not None:
+                self.bus.publish(EventBarrierAck(dpid, xid))
         else:
             log.debug("unhandled message type %d from %#x", msg_type, dpid)
         return dpid
@@ -352,8 +396,52 @@ class OFSouthbound:
         _m_sends.inc()
         return True
 
-    def flow_mod(self, dpid: int, mod: of.FlowMod) -> None:
-        self._send(dpid, ofwire.encode_flow_mod(mod, xid=self._next_xid()))
+    def flow_mod(self, dpid: int, mod: of.FlowMod) -> bool:
+        """Returns the queued/dropped send verdict (see _send) so
+        callers with bookkeeping — the recovery plane, the block-install
+        cookie record — never record a flow the wire never carried."""
+        return self._send(
+            dpid, ofwire.encode_flow_mod(mod, xid=self._next_xid())
+        )
+
+    # -- controller-side echo keepalive (ISSUE 5) --------------------------
+
+    def echo_tick(self, now: float | None = None) -> None:
+        """One keepalive pass: probe every connected datapath, abort any
+        whose previous probe aged past ``echo_timeout``. A half-open
+        peer (switch power-cut, NAT state loss, frozen middlebox)
+        otherwise looks connected forever — no bytes flow, so the
+        reader loop never errors, and EventDatapathDown never fires.
+        The abort forces connection_lost, which runs the reader loop's
+        full teardown path (datapath-down + switch-leave publication)."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        for dpid in list(self._writers):
+            pending = self._echo_pending.get(dpid)
+            if pending is not None:
+                xid, t0 = pending
+                if now - t0 >= self.echo_timeout:
+                    log.warning(
+                        "datapath %#x half-open: no echo reply in %.1fs; "
+                        "disconnecting", dpid, now - t0,
+                    )
+                    _m_echo_timeouts.inc()
+                    del self._echo_pending[dpid]
+                    w = self._writers.get(dpid)
+                    if w is not None:
+                        w.transport.abort()
+                continue  # probe still outstanding, not yet timed out
+            xid = self._next_xid()
+            if self._send(dpid, ofwire.encode_echo_request(b"", xid)):
+                self._echo_pending[dpid] = (xid, now)
+
+    async def run_echo(self) -> None:
+        """Asyncio keepalive loop (armed by the launcher when
+        ``Config.echo_interval_s`` > 0)."""
+        while True:
+            await asyncio.sleep(self.echo_interval)
+            self.echo_tick()
 
     #: byte cap per batched-install write slice (Config.install_highwater;
     #: the Controller overrides this from its config). Slicing exists to
@@ -363,29 +451,36 @@ class OFSouthbound:
     #: instead of being pushed into the aborted transport.
     install_highwater: int = 256 * 1024
 
-    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch) -> None:
+    def flow_mods_batch(self, dpid: int, batch: of.FlowModBatch):
         """Install a whole per-switch FlowMod burst: ONE batched wire
         encode (ofwire.encode_flow_mods_batch — numpy record assembly,
         no per-message struct.pack) flushed with writev-style sliced
         sends under the ``install_highwater`` backpressure cap. The
         bytes on the wire are identical to ``len(batch)`` flow_mod
         calls (asserted in tests/test_ofwire.py)."""
-        self.flow_mods_window(
+        return self.flow_mods_window(
             np.full(len(batch), dpid, np.int64), batch
         )
 
-    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> None:
+    def flow_mods_window(self, dpids, batch: of.FlowModBatch) -> InstallVerdict:
         """Install a whole *window's* FlowMods across switches: ``dpids``
         is the [N] per-row switch id, grouped (equal dpids contiguous —
         the Router's argsort guarantees it). The entire window is
         serialized in ONE batched encode; each switch receives its
         contiguous byte span of the blob (zero re-encoding per group),
         sliced under the ``install_highwater`` backpressure cap with the
-        stalled-peer check re-armed between slices."""
+        stalled-peer check re-armed between slices.
+
+        Returns an :class:`~sdnmpi_tpu.control.recovery.InstallVerdict`:
+        which switches got their whole span queued (terminated by an
+        OFPT_BARRIER_REQUEST when ``send_barriers`` — the ack is the
+        install's receipt), and which dropped mid-span and need the
+        recovery plane's retry queue. Fire-and-forget no more."""
         dpids = np.asarray(dpids)
+        verdict = InstallVerdict()
         n = len(batch)
         if n == 0:
-            return
+            return verdict
         from sdnmpi_tpu.utils.arrays import group_spans
 
         blob, offsets = ofwire.encode_flow_mods_spans(
@@ -398,12 +493,27 @@ class OFSouthbound:
         for lo, hi in group_spans(dpids):
             dpid = int(dpids[lo])
             span = blob[int(offsets[lo]) : int(offsets[hi])]
+            ok = True
             for off in range(0, len(span), step):
                 if not self._send(dpid, span[off : off + step]):
                     # peer unknown or cut for stalling: drop the rest
                     # of THIS switch's burst (other switches continue)
+                    ok = False
                     break
                 _m_slices.inc()
+            if not ok:
+                verdict.dropped.append(dpid)
+                continue
+            if self.send_barriers:
+                xid = self._next_xid()
+                if not self._send(dpid, ofwire.encode_barrier_request(xid)):
+                    # the span queued but its receipt cannot: treat the
+                    # whole span as suspect (the transport just died)
+                    verdict.dropped.append(dpid)
+                    continue
+                verdict.barriers.append((dpid, xid))
+            verdict.sent.append(dpid)
+        return verdict
 
     def packet_out(self, dpid: int, out: of.PacketOut) -> None:
         self._send(dpid, ofwire.encode_packet_out(out, xid=self._next_xid()))
@@ -455,15 +565,44 @@ class OFSouthbound:
                     else:
                         actions = (of.ActionOutput(int(hop_port[s, h])),)
                     dpid = int(hop_dpid[s, h])
-                    self.flow_mod(dpid, of.FlowMod(
+                    if self.flow_mod(dpid, of.FlowMod(
                         match, actions, block.priority, cookie=block.cookie,
-                    ))
-                    installed.append((dpid, match, block.priority))
+                    )):
+                        # record only flows the wire actually carried: a
+                        # dropped send recorded here would make teardown
+                        # delete flows that were never installed (and,
+                        # worse, any identical match a later install DID
+                        # put there)
+                        installed.append((dpid, match, block.priority))
 
     def flow_blocks_delete(self, cookie: int) -> None:
         """Tear down a collective install: one OFPFC_DELETE per recorded
-        exact match (see flow_block_set)."""
-        for dpid, match, priority in self._cookie_flows.pop(cookie, []):
-            self.flow_mod(dpid, of.FlowMod(
-                match, (), priority, command=of.OFPFC_DELETE, cookie=cookie,
+        exact match (see flow_block_set), the whole teardown serialized
+        through ONE batched ``encode_flow_mods_spans`` window per
+        priority (the same path as ``Router._del_flows_window`` — a
+        large collective's teardown is a delete storm, and per-mod
+        scalar encodes cost what the batched installs already
+        eliminated). Byte-identical to the scalar per-mod loop modulo
+        the xid sequence (differential-tested in tests/test_recovery.py)."""
+        rows = self._cookie_flows.pop(cookie, [])
+        if not rows:
+            return
+        from sdnmpi_tpu.utils.mac import macs_to_ints
+
+        # one window per priority (priorities are uniform per block, but
+        # a shared cookie across blocks must not cross-contaminate)
+        by_prio: dict[int, list] = {}
+        for dpid, match, priority in rows:
+            by_prio.setdefault(priority, []).append((dpid, match))
+        for priority, group in sorted(by_prio.items()):
+            kd = np.array([d for d, _ in group], np.int64)
+            order = np.argsort(kd, kind="stable")
+            self.flow_mods_window(kd[order], of.FlowModBatch(
+                src=macs_to_ints([m.dl_src for _, m in group])[order],
+                dst=macs_to_ints([m.dl_dst for _, m in group])[order],
+                out_port=np.zeros(len(group), np.int32),  # DELETE: no actions
+                rewrite=None,
+                priority=priority,
+                command=of.OFPFC_DELETE,
+                cookie=cookie,
             ))

@@ -124,6 +124,12 @@ def config_from_args(args) -> Config:
         mesh_devices=args.mesh_devices,
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
+        recovery_plane=not getattr(args, "no_recovery", False),
+        install_barriers=not getattr(args, "no_install_barriers", False),
+        install_retry_max=getattr(args, "install_retry_max", 4),
+        install_retry_backoff_s=getattr(args, "install_retry_backoff", 0.25),
+        echo_interval_s=getattr(args, "echo_interval", 15.0),
+        echo_timeout_s=getattr(args, "echo_timeout", 45.0),
     )
 
 
@@ -173,6 +179,10 @@ async def amain(args) -> None:
 
     if spec is None:
         await fabric.serve()  # accept real OF 1.0 switches
+        if config.echo_interval_s > 0 and hasattr(fabric, "run_echo"):
+            # controller-side keepalive: kill half-open datapaths so
+            # EventDatapathDown — and the reconcile on redial — fires
+            tasks.append(asyncio.create_task(fabric.run_echo()))
         if (
             controller.discovery is not None
             and config.lldp_reprobe_interval > 0
@@ -187,6 +197,20 @@ async def amain(args) -> None:
 
             tasks.append(asyncio.create_task(reprobe()))
     else:
+        chaos = None
+        if getattr(args, "chaos", None) is not None:
+            # live chaos demo: the same fault plan the recovery tests
+            # soak under, stepping once per fabric clock tick
+            from sdnmpi_tpu.control.faults import FaultPlan
+
+            chaos = FaultPlan(
+                seed=args.chaos,
+                p_send_drop=0.05, p_send_stall=0.03, p_send_truncate=0.02,
+                p_ack_drop=0.03, p_stats_delay=0.1,
+                p_crash=0.05, p_redial=0.5, p_flap=0.08, p_restore=0.5,
+            ).attach(fabric)
+            log.info("chaos fault plan armed (seed %d)", args.chaos)
+
         async def clock() -> None:
             # drive the fabric's flow-expiry clock (a real switch ages
             # its own flows; the sim needs the tick) — cheap no-op while
@@ -194,6 +218,8 @@ async def amain(args) -> None:
             loop = asyncio.get_running_loop()
             while True:
                 fabric.tick(loop.time())
+                if chaos is not None:
+                    chaos.step()
                 await asyncio.sleep(1.0)
 
         tasks.append(asyncio.create_task(clock()))
@@ -303,6 +329,45 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh-devices", type=int, default=0,
         help="shard the DAG balancer over the first N local devices "
         "(0 = single-device)",
+    )
+    parser.add_argument(
+        "--no-recovery", action="store_true",
+        help="disable the failure-domain recovery plane (desired-flow "
+        "reconciliation, install retries, anti-entropy) — restores the "
+        "fire-and-forget legacy for differential runs",
+    )
+    parser.add_argument(
+        "--no-install-barriers", action="store_true",
+        help="do not terminate batched install windows with "
+        "OFPT_BARRIER_REQUEST (no acked installs)",
+    )
+    parser.add_argument(
+        "--install-retry-max", type=int, default=4,
+        help="bounded retries per switch for dropped/un-acked install "
+        "windows before escalating to a full resync",
+    )
+    parser.add_argument(
+        "--install-retry-backoff", type=float, default=0.25,
+        help="base seconds of the install retry queue's exponential "
+        "backoff (doubles per attempt, +25%% seeded jitter)",
+    )
+    parser.add_argument(
+        "--echo-interval", type=float, default=15.0,
+        help="controller-side echo keepalive period for real TCP "
+        "datapaths in --listen mode, seconds (0 = off)",
+    )
+    parser.add_argument(
+        "--echo-timeout", type=float, default=45.0,
+        help="seconds without an echo reply before a half-open "
+        "datapath is disconnected",
+    )
+    parser.add_argument(
+        "--chaos", type=int, default=None, metavar="SEED",
+        help="arm a seeded fault-injection plan (control/faults.py) "
+        "against the simulated fabric: switch crashes/redials, link "
+        "flaps, dropped/stalled/truncated installs, delayed stats — "
+        "one chaos step per fabric clock tick; watch the recovery "
+        "counters converge it back",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
     parser.add_argument(

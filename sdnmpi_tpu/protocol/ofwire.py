@@ -59,6 +59,8 @@ OFPT_PACKET_OUT = 13
 OFPT_FLOW_MOD = 14
 OFPT_STATS_REQUEST = 16
 OFPT_STATS_REPLY = 17
+OFPT_BARRIER_REQUEST = 18
+OFPT_BARRIER_REPLY = 19
 
 # ofp_flow_mod_flags
 OFPFF_SEND_FLOW_REM = 1 << 0
@@ -571,6 +573,27 @@ def decode_flow_removed(buf: bytes) -> dict:
         "reason": reason, "duration_sec": dur_s, "idle_timeout": idle_t,
         "packet_count": pkts, "byte_count": bts,
     }
+
+
+def encode_barrier_request(xid: int = 0) -> bytes:
+    """ofp_header-only OFPT_BARRIER_REQUEST — terminates each batched
+    install span so the switch's reply (spec §5.3.7: everything before
+    the barrier has been processed) is the install's end-to-end receipt
+    (control/recovery.py). The reference never sent one; its installs
+    were fire-and-forget."""
+    return _pack(OFPT_BARRIER_REQUEST, b"", xid)
+
+
+def encode_barrier_reply(xid: int = 0) -> bytes:
+    return _pack(OFPT_BARRIER_REPLY, b"", xid)
+
+
+def decode_barrier_reply(buf: bytes) -> int:
+    """Returns the xid echoing the request's (the pending-barrier key)."""
+    msg_type, _length, xid = peek_header(buf)
+    if msg_type != OFPT_BARRIER_REPLY:
+        raise ValueError(f"not a barrier_reply (type {msg_type})")
+    return xid
 
 
 def encode_error(err_type: int, code: int, data: bytes = b"",

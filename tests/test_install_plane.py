@@ -281,6 +281,7 @@ class TestBackpressure:
             return True  # _send contract: bytes queued
 
         sb._send = send
+        sb.send_barriers = False  # slicing under test, not acked installs
         sb.install_highwater = 160  # two 80-byte messages per slice
         n = 5
         batch = of.FlowModBatch(

@@ -274,3 +274,35 @@ class TestLiveRun:
             i for i, r in enumerate(records) if r["event"] == "EventProcessAdd"
         )
         assert first_pktin < first_add
+
+
+class TestRecoveryFlags:
+    def test_recovery_flag_defaults(self):
+        args = _parse([])
+        cfg = launch.config_from_args(args)
+        assert cfg.recovery_plane and cfg.install_barriers
+        assert cfg.install_retry_max == 4
+        assert cfg.echo_interval_s == 15.0 and cfg.echo_timeout_s == 45.0
+        assert args.chaos is None
+
+    def test_recovery_flags_map_to_config(self):
+        args = _parse([
+            "--no-recovery", "--no-install-barriers",
+            "--install-retry-max", "7", "--install-retry-backoff", "0.5",
+            "--echo-interval", "3", "--echo-timeout", "9",
+            "--chaos", "42",
+        ])
+        cfg = launch.config_from_args(args)
+        assert not cfg.recovery_plane and not cfg.install_barriers
+        assert cfg.install_retry_max == 7
+        assert cfg.install_retry_backoff_s == 0.5
+        assert cfg.echo_interval_s == 3.0 and cfg.echo_timeout_s == 9.0
+        assert args.chaos == 42
+
+    def test_chaos_live_run_survives(self, tmp_path):
+        """A short live run with the chaos plan armed must exit cleanly
+        (the fault plan steps inside the fabric clock task)."""
+        run = TestLiveRun()
+        asyncio.run(launch.amain(run._args(
+            tmp_path, chaos=0, duration=0.3,
+        )))
