@@ -209,6 +209,44 @@ def check_rows(rows) -> list[str]:
     return errors
 
 
+#: fractional vs_baseline drop that fails the regression gate
+REGRESSION_TOLERANCE = 0.2
+
+
+def check_regression(
+    rows, baseline_rows, tolerance: float = REGRESSION_TOLERANCE
+) -> list[str]:
+    """Regressions of fresh suite rows against a committed suite
+    ([] = clean): a row fails when its ``vs_baseline`` drops more than
+    ``tolerance`` (fractional) below the committed row with the same
+    (config, metric). Error rows are the run-failure gate's job, rows
+    absent from the committed file are new metrics (must not fail the
+    gate), and non-numeric/missing vs_baseline on either side is a
+    schema problem for ``check_rows``, so all three are skipped here."""
+    committed = {
+        (r.get("config"), r.get("metric")): r.get("vs_baseline")
+        for r in baseline_rows
+        if isinstance(r, dict) and "error" not in r
+    }
+    errors = []
+    for row in rows:
+        if not isinstance(row, dict) or "error" in row:
+            continue
+        want = committed.get((row.get("config"), row.get("metric")))
+        got = row.get("vs_baseline")
+        if not isinstance(want, (int, float)) or not isinstance(
+            got, (int, float)
+        ):
+            continue
+        if want > 0 and got < want * (1 - tolerance):
+            errors.append(
+                f"config {row['config']} ({row['metric']}): vs_baseline "
+                f"{got:.4g} regressed more than {tolerance:.0%} below the "
+                f"committed {want:.4g}"
+            )
+    return errors
+
+
 def check_schema(root: pathlib.Path) -> list[str]:
     """Validate every row-list BENCH_*.json under ``root`` (the suite
     files; per-round driver logs like BENCH_r01.json hold a single
@@ -227,15 +265,41 @@ def check_schema(root: pathlib.Path) -> list[str]:
     return errors
 
 
+def _load_gate(path: str) -> list[dict]:
+    """The committed suite rows of --regression-gate; a missing or
+    malformed file is a hard error BEFORE anything runs — a typo must
+    not burn a TPU suite and then silently skip the gate."""
+    try:
+        rows = json.loads(pathlib.Path(path).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        sys.exit(f"--regression-gate: cannot read {path}: {e}")
+    if not isinstance(rows, list):
+        sys.exit(f"--regression-gate: {path} is not a suite row list")
+    return rows
+
+
 def main() -> None:
     root = pathlib.Path(__file__).resolve().parent.parent
     args = sys.argv[1:]
+    gate_path = None
+    for i, a in enumerate(args):
+        if a == "--regression-gate":
+            if i + 1 >= len(args) or args[i + 1].startswith("--"):
+                sys.exit("--regression-gate needs a committed suite file")
+            gate_path = args[i + 1]
+            args = args[:i] + args[i + 2 :]
+            break
+        if a.startswith("--regression-gate="):
+            gate_path = a.split("=", 1)[1]
+            args = args[:i] + args[i + 1 :]
+            break
     flags = {a for a in args if a.startswith("--")}
     if unknown_flags := flags - {"--json-schema-check", "--metrics-dump"}:
         # a typo'd flag must not silently launch the full TPU suite
         sys.exit(f"unknown flag(s) {sorted(unknown_flags)}")
     schema_only = "--json-schema-check" in flags
     metrics_dump = "--metrics-dump" in flags
+    gate_rows = _load_gate(gate_path) if gate_path is not None else None
     only = {a for a in args if not a.startswith("--")}
     known = {name for name, _ in CONFIGS}
     if unknown := only - known:
@@ -247,16 +311,27 @@ def main() -> None:
                 "rows and takes no config ids"
             )
         # validate without running anything — the pre-merge gate CI
-        # runs against BENCH_*.json
+        # runs against BENCH_*.json. With --regression-gate the on-disk
+        # suite is ALSO gated against the committed file (still no run).
         errors = check_schema(root)
+        if gate_rows is not None:
+            try:
+                current = json.loads((root / "BENCH_suite.json").read_text())
+            except (OSError, json.JSONDecodeError) as e:
+                errors.append(f"BENCH_suite.json unreadable for gate: {e}")
+            else:
+                errors.extend(check_regression(current, gate_rows))
         for e in errors:
             print(e, file=sys.stderr)
         print(f"json-schema-check: {len(errors)} violation(s)")
         sys.exit(1 if errors else 0)
     results = run_suite(CONFIGS, root, only, metrics_dump=metrics_dump)
     failed = [r for r in results if "error" in r]
-    # post-run gate: whatever just landed must also be well-formed
+    # post-run gate: whatever just landed must also be well-formed...
     errors = check_rows(results)
+    if gate_rows is not None:
+        # ...and no fresher than 20%-worse vs the committed suite
+        errors += check_regression(results, gate_rows)
     for e in errors:
         print(e, file=sys.stderr)
     sys.exit(1 if (failed or errors) else 0)

@@ -58,6 +58,18 @@ class RPCInterface:
         bus.subscribe(ev.EventProcessDelete, lambda e: self._broadcast("delete_process", e.rank))
         bus.subscribe(ev.EventFDBUpdate, lambda e: self._broadcast("update_fdb", e.dpid, e.src, e.dst, e.port))
         bus.subscribe(ev.EventFDBRemove, lambda e: self._broadcast("remove_fdb", e.dpid, e.src, e.dst))
+        # teardown BURSTS (revalidation passes, rank exits) arrive as one
+        # EventFDBRemoveBatch and leave as one notification — a link flap
+        # must not cost the mirror hundreds of remove_fdb broadcasts.
+        # Extension method beyond the reference protocol; per-row
+        # removals (flow expiry) keep the reference's remove_fdb above.
+        bus.subscribe(
+            ev.EventFDBRemoveBatch,
+            lambda e: self._broadcast(
+                "remove_fdb_batch",
+                [[dpid, src, dst] for dpid, src, dst in e.rows],
+            ),
+        )
         # entity payloads go through the Ryu-3.26-exact wire ABI
         # (api/wire.py) so a reference visualizer parses them unchanged
         bus.subscribe(ev.EventSwitchEnter, lambda e: self._broadcast("add_switch", wire.switch(e.switch)))

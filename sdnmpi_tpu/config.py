@@ -131,6 +131,17 @@ class Config:
     #: Above the threshold — or when the delta log was broken by a
     #: structural mutation — the full kernel runs. 0 disables repair.
     delta_repair_threshold: int = 8
+    #: end-to-end incremental churn dataflow (ISSUE 6): flow
+    #: revalidation after a topology delta narrows to the flows whose
+    #: installed paths touch a dirtied switch, re-scores them through
+    #: the oracle's delta entry point (dirty set as a device mask
+    #: tensor, batch riding the incrementally-repaired APSP), diffs
+    #: per-pair hop spans, and re-drives only the changed spans through
+    #: the batched install windows. False restores the full
+    #: re-route-everything pass (the differential-testing escape hatch:
+    #: narrowed and full passes must leave bit-identical FDB + desired
+    #: state — asserted in tests/test_delta_reval.py).
+    delta_reval: bool = True
     #: coalesce concurrent route lookups (unicast + MPI packet-ins)
     #: into one padded batched oracle call instead of one device
     #: dispatch per packet-in. Flushed when the southbound goes idle

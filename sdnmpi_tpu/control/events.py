@@ -186,6 +186,14 @@ class DispatchRoutesBatchRequest(Request):
     dst = "TopologyManager"
     pairs: list  # [(src_mac, dst_mac), ...]
     policy: str = "shortest"
+    #: dirtied-switch dpid set of the delta-narrowed churn dataflow
+    #: (None = plain batch). With ``policy="shortest"`` the oracle
+    #: re-scores the pairs against the incrementally-repaired APSP with
+    #: the set as a device mask tensor, and the reaped window's
+    #: ``touched`` array marks pairs whose new path crosses it — the
+    #: Router's drain-attribution telemetry
+    #: (TopologyDB.find_routes_batch_delta_dispatch).
+    dirty: Any = None
 
 
 @dataclasses.dataclass
@@ -325,6 +333,32 @@ class EventFDBRemove(Event):
     dpid: int
     src: str
     dst: str
+
+
+@dataclasses.dataclass
+class EventFDBRemoveBatch(Event):
+    """One teardown *burst* — a revalidation pass or rank exit tears
+    down hundreds of rows at once, and per-row :class:`EventFDBRemove`
+    publishes cost one RPC broadcast each. The Router publishes bursts
+    as ONE of these (``rows`` is ``[(dpid, src, dst), ...]``); single
+    removals (flow expiry, datapath down of a lone flow) keep the
+    per-row event. Subscribers that only understand per-row removals
+    attach through :func:`subscribe_fdb_removes` — the compat shim that
+    expands batches for them."""
+
+    rows: list  # [(dpid, src, dst), ...]
+
+
+def subscribe_fdb_removes(bus, handler) -> None:
+    """Compat shim: deliver every FDB removal — batched or per-row — to
+    a per-row ``handler(EventFDBRemove)``. Existing per-row consumers
+    subscribe here instead of to :class:`EventFDBRemove` alone and see
+    the exact pre-batching event stream."""
+    bus.subscribe(EventFDBRemove, handler)
+    bus.subscribe(
+        EventFDBRemoveBatch,
+        lambda e: [handler(EventFDBRemove(*row)) for row in e.rows],
+    )
 
 
 @dataclasses.dataclass

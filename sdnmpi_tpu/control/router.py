@@ -23,6 +23,8 @@ import dataclasses
 import logging
 import time
 
+import numpy as np
+
 from sdnmpi_tpu.config import Config, DEFAULT_CONFIG
 from sdnmpi_tpu.control import events as ev
 from sdnmpi_tpu.control.bus import EventBus
@@ -106,6 +108,12 @@ _m_revalidations = REGISTRY.counter(
 _m_revalidations_skipped = REGISTRY.counter(
     "router_revalidations_skipped_total",
     "revalidation passes skipped by the epoch gate",
+)
+_m_reval_drained = REGISTRY.counter(
+    "router_reval_flows_drained_total",
+    "re-scored flows whose NEW path moved off the dirtied switches "
+    "entirely (the delta window's device-computed touched verdict): "
+    "how much traffic a flap drains away from the failed region",
 )
 
 
@@ -292,7 +300,6 @@ class Router:
                     delete_rows=failed,
                 )
             return
-        import numpy as np
 
         from sdnmpi_tpu.utils.mac import macs_to_ints
 
@@ -605,16 +612,20 @@ class Router:
                 # >1 = device compute overlapped host decode+install
                 _m_overlap_gain.set((stage_wall + hidden_wall) / e2e)
 
-    def _dispatch_window(self, pairs, policy: str = "shortest"):
+    def _dispatch_window(self, pairs, policy: str = "shortest", dirty=None):
         """Dispatch one window through the split-phase oracle API, or
         None when the serial path must be used (pipelining disabled, or
         a bus without the dispatch provider — e.g. minimal test
-        stacks)."""
+        stacks). ``dirty`` is the delta-narrowed revalidation's dirtied
+        dpid set: the oracle re-scores the pairs with it as a device
+        mask tensor and the reaped window carries per-pair ``touched``
+        verdicts (events.DispatchRoutesBatchRequest)."""
         if not self.config.pipelined_install:
             return None
         try:
             return self.bus.request(
-                ev.DispatchRoutesBatchRequest(pairs, policy=policy)
+                ev.DispatchRoutesBatchRequest(pairs, policy=policy,
+                                              dirty=dirty)
             ).window
         except LookupError:
             return None
@@ -626,7 +637,6 @@ class Router:
         vectorized FlowMod materialization + batched install for the
         whole window, then per-packet packet-out / broadcast fallback
         (the per-packet leg is inherently scalar — one PacketOut each)."""
-        import numpy as np
 
         t0 = time.perf_counter()
         isp = wsp.child("install")
@@ -678,7 +688,6 @@ class Router:
         with only the per-switch batch entry point get per-group
         bursts; ones with neither fall back to the scalar per-hop
         path. Returns the [F] bool routable mask."""
-        import numpy as np
 
         ln = np.asarray(wr.hop_len)
         routable = ln > 0
@@ -883,7 +892,6 @@ class Router:
         reference would have run 16.7M packet-in -> DFS -> per-hop
         FlowMod cycles for the same outcome (reference:
         sdnmpi/router.py:125-160, sdnmpi/util/topology_db.py:59-84)."""
-        import numpy as np
 
         from sdnmpi_tpu import native
         from sdnmpi_tpu.utils.mac import macs_to_ints
@@ -964,12 +972,22 @@ class Router:
             )
         )
 
+        # the dirty-set index for delta-narrowed revalidation: which
+        # switches this collective's routed blocks actually ride (pad
+        # rows are -1; unroutable sub-flows contribute nothing). One
+        # np.unique over the hop arrays at install time buys skipping
+        # whole-collective re-routes for every disjoint link flap later.
+        hop_dpid = np.asarray(routes.hop_dpid)
+        touched = frozenset(
+            int(d) for d in np.unique(hop_dpid[hop_dpid >= 0])
+        )
         self.collectives.add(
             CollectiveInstall(
                 cookie, coll_type, tuple(ranks), root_rank,
                 policy, macs_str, src_idx, dst_idx,
                 n_pairs=len(src_idx), n_flows=n_flows,
                 max_congestion=routes.max_congestion,
+                switches=touched,
             )
         )
         self.bus.publish(
@@ -1014,10 +1032,27 @@ class Router:
         self.recovery.desired.remove(event.dpid, src, dst)
         self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
 
+    def _publish_fdb_removes(self, rows: list[tuple[int, str, str]]) -> None:
+        """Mirror a teardown northbound: ONE
+        :class:`~sdnmpi_tpu.control.events.EventFDBRemoveBatch` for a
+        burst (a revalidation pass or rank exit tears down hundreds of
+        rows — per-row events cost one RPC broadcast each), the
+        pre-batch per-row :class:`EventFDBRemove` for a single removal.
+        Per-row-only consumers attach via ``ev.subscribe_fdb_removes``
+        (the compat shim expanding batches)."""
+        if not rows:
+            return
+        if len(rows) == 1:
+            self.bus.publish(ev.EventFDBRemove(*rows[0]))
+        else:
+            self.bus.publish(ev.EventFDBRemoveBatch(list(rows)))
+
     def _datapath_down(self, event: ev.EventDatapathDown) -> None:
         self.dps.discard(event.dpid)
-        for (src, dst), _ in list(self.fdb.fdb.get(event.dpid, {}).items()):
-            self.bus.publish(ev.EventFDBRemove(event.dpid, src, dst))
+        self._publish_fdb_removes([
+            (event.dpid, src, dst)
+            for (src, dst) in self.fdb.fdb.get(event.dpid, {})
+        ])
         self.fdb.remove_switch(event.dpid)
         # pending barriers/retries are moot; the DESIRED set survives —
         # it is exactly what the reconciler re-drives on redial
@@ -1092,7 +1127,6 @@ class Router:
             return InstallVerdict(
                 sent=[dpid] if ok else [], dropped=[] if ok else [dpid]
             )
-        import numpy as np
 
         from sdnmpi_tpu.utils.mac import macs_to_ints
 
@@ -1127,7 +1161,6 @@ class Router:
             return InstallVerdict(
                 sent=[dpid] if ok else [], dropped=[] if ok else [dpid]
             )
-        import numpy as np
 
         from sdnmpi_tpu.utils.mac import mac_to_int, macs_to_ints
 
@@ -1247,20 +1280,23 @@ class Router:
           EventTopologyChanged with no TopologyDB version bump and no
           UtilPlane epoch publish) — skip the pass entirely;
         - a non-empty set of dpids: the delta log covers the gap with
-          pure link deltas, so only flows whose installed paths touch
-          one of these switches re-route. Deliberate trade-off: a link
-          ADD can in principle shorten paths that don't touch its
-          endpoints, and those flows keep their still-valid (possibly
-          no-longer-shortest) routes until a later delta dirties their
-          path, a full pass runs, or the flow's idle/hard timeout
-          recycles it — correctness (no flow rides a deleted link) is
-          what the narrowing preserves, global re-optimization is what
-          it defers, and re-running the oracle over every installed
-          flow per cable restore is exactly the cost this gate exists
-          to remove;
+          pure link *deletes*, so only flows whose installed paths
+          touch one of these switches re-route. Delete narrowing is
+          SOUND, not just safe: a pair's chosen shortest path changes
+          under a delete only if it rode the deleted link, so its
+          installed hops contain both endpoints and the pair is always
+          narrowed in — narrowed and full passes leave bit-identical
+          FDB/desired state (the ISSUE-6 differential fence,
+          tests/test_delta_reval.py);
         - None: no basis to narrow (first pass, broken/overflowed
-          delta log, host/switch membership deltas, or the utilization
-          plane moved under an unchanged graph) — full pass.
+          delta log, host/switch membership deltas, the utilization
+          plane moved under an unchanged graph, ``Config.delta_reval``
+          off, or the gap contains a link ADD) — full pass. Adds fall
+          back deliberately: a restored cable can shorten flows whose
+          CURRENT detour avoids both of its endpoints entirely (a
+          torus neighbor pair's around-the-ring detour), so endpoint
+          narrowing would strand stale routes and break the
+          narrowed-vs-full bit-identity the escape hatch guarantees.
 
         Precedence note: when the graph changed AND the utilization
         plane also moved, the link-delta narrowing still applies — the
@@ -1290,6 +1326,8 @@ class Router:
         if version == last_v:
             # duplicate topology signal; skip unless utilization moved
             return set() if util_epoch == last_u else None
+        if not self.config.delta_reval:
+            return None  # escape hatch: always the full pass
         deltas_since = getattr(db, "deltas_since", None)
         deltas = deltas_since(last_v) if deltas_since else None
         if deltas is None:
@@ -1297,7 +1335,9 @@ class Router:
         dirty: set[int] = set()
         for entry in deltas:
             kind = entry[1]
-            if kind in ("link+", "link-"):
+            if kind == "link+":
+                return None  # adds re-optimize globally (docstring)
+            if kind == "link-":
                 dirty.add(entry[2])
                 dirty.add(entry[3])
             elif kind == "switch_upsert":
@@ -1313,22 +1353,36 @@ class Router:
     def _revalidate_flows(self) -> None:
         """Recompute installed routes after a topology change; tear down
         hops that no longer lie on the chosen path and eagerly reinstall
-        the surviving routes. Block-installed collectives are re-routed
-        wholesale (one oracle call each) — their granularity is the
-        collective, not the pair.
+        the surviving routes — the control-plane leg of the incremental
+        churn dataflow (ISSUE 6).
 
-        Epoch-gated: a pass with neither the TopologyDB version nor the
-        UtilPlane epoch advanced since the last one is a no-op, and when
-        the PR-1 delta log covers the gap with pure link deltas, only
-        the flows whose installed paths touch a dirtied switch re-route
-        — a cable flap on one spine no longer re-runs the oracle over
-        every flow in the fabric."""
+        Epoch-gated and delta-narrowed end to end: a pass with neither
+        the TopologyDB version nor the UtilPlane epoch advanced is a
+        no-op; when the PR-1 delta log covers the gap with pure link
+        deltas (and ``Config.delta_reval``), only the flows whose
+        installed paths touch a dirtied switch re-route, and
+        block-installed collectives re-route only when the dirtied set
+        intersects the switches their installed blocks actually ride.
+        Surviving flows re-score through the oracle's delta entry point
+        in PIPELINED dispatch/reap windows (window k+1's device compute
+        overlaps window k's diff + install), per-pair hop diffs tear
+        down and reinstall only the *changed spans*, and both the
+        teardown and the reinstall ship as batched windows
+        (``_del_flows_window`` / ``_install_window``) instead of scalar
+        per-hop FlowMods. A cable flap costs O(affected flows), never a
+        re-route of the fabric."""
         dirty = self._reval_dirty_set()
         if dirty is not None and not dirty:
             _m_revalidations_skipped.inc()
             return  # nothing advanced since the last pass
         _m_revalidations.inc()
         for install in self.collectives:
+            if (
+                dirty is not None
+                and install.switches
+                and dirty.isdisjoint(install.switches)
+            ):
+                continue  # none of its installed blocks ride a dirty switch
             self._remove_collective(install)
             self._reinstall_collective(install)
 
@@ -1351,50 +1405,111 @@ class Router:
                 # the rank behind this vMAC is gone: tear it all down
                 for dpid, _ in flows[(src, dst)].items():
                     self.fdb.remove(dpid, src, dst)
-                    self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
                     doomed.append((dpid, src, dst))
                 continue
             resolved.append(((src, dst), effective))
-
-        fdbs = self.bus.request(
-            ev.FindRoutesBatchRequest([(src, eff) for (src, _), eff in resolved])
-        ).fdbs
-
-        reinstall: list[tuple[list, str, str, str | None]] = []
-        for ((src, dst), effective), new_fdb in zip(resolved, fdbs):
-            installed = flows[(src, dst)]
-            new_hops = dict(new_fdb)
-            for dpid, port in installed.items():
-                if new_hops.get(dpid) != port:
-                    self.fdb.remove(dpid, src, dst)
-                    self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
-                    doomed.append((dpid, src, dst))
-            if new_fdb:
-                true_dst = effective if is_sdn_mpi_addr(dst) else None
-                reinstall.append((new_fdb, src, dst, true_dst))
-        # deletes flush as ONE batched OFPFC_DELETE window BEFORE any
-        # reinstall: a rerouted pair's new flow shares the old one's
-        # (src, dst) match, so a delete landing after the install would
-        # wipe the fresh entry too
+        self._publish_fdb_removes(doomed)
         self._del_flows_window(doomed)
-        for new_fdb, src, dst, true_dst in reinstall:
-            self._add_flows_for_path(new_fdb, src, dst, true_dst)
+
+        from sdnmpi_tpu.oracle.batch import WindowRoutes
+
+        def process(chunk, wr) -> None:
+            """Diff + re-drive one reaped window: per-pair hop diffs
+            pick the changed spans; the span teardown flushes as ONE
+            batched OFPFC_DELETE window BEFORE the reinstall window (a
+            rerouted pair's new flow shares the old one's (src, dst)
+            match, so a delete landing after the install would wipe the
+            fresh entry too), and the reinstall ships through the same
+            vectorized window installer the packet-in path uses — the
+            FDB dedup inside it keeps surviving hops untouched, so only
+            changed spans reach the wire."""
+            chunk_doomed: list[tuple[int, str, str]] = []
+            entries: list[tuple[str, str, str | None]] = []
+            for k, ((src, dst), effective) in enumerate(chunk):
+                installed = flows[(src, dst)]
+                n = int(wr.hop_len[k])
+                new_hops = {
+                    int(wr.hop_dpid[k, h]): int(wr.hop_port[k, h])
+                    for h in range(n)
+                }
+                for dpid, port in installed.items():
+                    if new_hops.get(dpid) != port:
+                        self.fdb.remove(dpid, src, dst)
+                        chunk_doomed.append((dpid, src, dst))
+                entries.append((
+                    src, dst, effective if is_sdn_mpi_addr(dst) else None
+                ))
+            self._publish_fdb_removes(chunk_doomed)
+            self._del_flows_window(chunk_doomed)
+            self._install_window(entries, wr)
+            if wr.touched is not None:
+                # device-computed attribution: flows whose new path left
+                # the dirty region entirely (they drained off the flap)
+                _m_reval_drained.inc(
+                    int(np.count_nonzero(~wr.touched & (wr.hop_len > 0)))
+                )
+
+        # pipelined re-scoring: windows of coalesce_max_batch pairs
+        # double-buffer through the delta dispatch API — window k+1
+        # computes on device while window k diffs and installs
+        step = max(1, self.config.coalesce_max_batch)
+        prev: tuple | None = None  # (chunk, window)
+        for lo in range(0, len(resolved) + 1, step):
+            chunk = resolved[lo : lo + step]
+            window = None
+            if chunk:
+                pairs = [(src, eff) for (src, _), eff in chunk]
+                window = self._dispatch_window(pairs, dirty=dirty)
+                if window is None:
+                    # serial fallback (pipelining off / minimal stacks):
+                    # blocking batch request, same diff + install legs
+                    if prev is not None:
+                        process(prev[0], prev[1].reap())
+                        prev = None
+                    reply = self.bus.request(
+                        ev.FindRoutesBatchRequest(pairs)
+                    )
+                    process(chunk, WindowRoutes.from_fdbs(reply.fdbs))
+                    continue
+            if prev is not None:
+                process(prev[0], prev[1].reap())
+            prev = (chunk, window) if chunk else None
+        if prev is not None:  # last partial chunk (len % step != 0):
+            # the trailing empty range slot that would have flushed it
+            # only exists when len(resolved) is a step multiple
+            process(prev[0], prev[1].reap())
 
     def _reinstall_collective(self, install: CollectiveInstall) -> None:
         """Re-route a previously installed collective against the current
         topology/process state (used by revalidation and restore). The
-        rankdb is re-consulted so moved ranks get their new MACs."""
-        import numpy as np
-
+        rankdb is re-consulted so moved ranks get their new MACs, and
+        ranks that exited since the install are dropped — only the LIVE
+        rank subset is reinstalled (pattern pairs touching a dead rank
+        are filtered and the survivors remapped onto the live rank
+        list), so the new install's record and signature describe what
+        is actually on the switches instead of leaking dead ranks."""
         rankdb = self.bus.request(ev.CurrentProcessAllocationRequest()).processes
-        live = [r for r in install.ranks if rankdb.get_mac(r)]
-        if len(live) < 2:
+        alive = np.array(
+            [bool(rankdb.get_mac(r)) for r in install.ranks], bool
+        )
+        if int(alive.sum()) < 2:
             return
+        src_idx = np.asarray(install.src_idx)
+        dst_idx = np.asarray(install.dst_idx)
+        ranks = list(install.ranks)
+        if not alive.all():
+            keep = alive[src_idx] & alive[dst_idx]
+            if not keep.any():
+                return
+            remap = (np.cumsum(alive) - 1).astype(np.int32)
+            src_idx = remap[src_idx[keep]]
+            dst_idx = remap[dst_idx[keep]]
+            ranks = [r for r, a in zip(ranks, alive) if a]
         self._install_collective_blocks(
             install.coll_type,
-            list(install.ranks),
-            install.root,
-            np.stack([install.src_idx, install.dst_idx], axis=1),
+            ranks,
+            install.root if install.root in ranks else None,
+            np.stack([src_idx, dst_idx], axis=1),
             rankdb,
             policy=install.policy,
         )
@@ -1415,8 +1530,10 @@ class Router:
                 doomed.append((dpid, src, dst))
         for dpid, src, dst in doomed:
             self.fdb.remove(dpid, src, dst)
-            self.bus.publish(ev.EventFDBRemove(dpid, src, dst))
-        # one batched OFPFC_DELETE window for the whole rank exit
+        # one EventFDBRemoveBatch + one batched OFPFC_DELETE window for
+        # the whole rank exit (the RPC mirror gets one message, not one
+        # per torn-down row)
+        self._publish_fdb_removes(doomed)
         self._del_flows_window(doomed)
 
     def reinstall_pairs(self, pairs: list[tuple[str, str]]) -> None:

@@ -191,6 +191,16 @@ class TopologyManager:
         handler, so a dispatched window routes identically to the same
         pairs through FindRoutesBatchRequest."""
         cfg = self.config
+        if req.dirty is not None and req.policy == "shortest":
+            # delta-narrowed churn re-scoring: the dirty set rides to
+            # the oracle as a mask tensor and the window's touched
+            # array feeds the drain-attribution telemetry
+            # (control/router.py router_reval_flows_drained_total)
+            return ev.DispatchRoutesBatchReply(
+                self.topologydb.find_routes_batch_delta_dispatch(
+                    req.pairs, req.dirty
+                )
+            )
         kwargs = {}
         if req.policy == "balanced":
             kwargs = dict(

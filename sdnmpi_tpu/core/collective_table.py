@@ -32,6 +32,11 @@ class CollectiveInstall:
     n_pairs: int = 0
     n_flows: int = 0  # switch-level flow entries across all blocks
     max_congestion: float = 0.0
+    #: dpids the install's routed blocks actually ride — the dirty-set
+    #: index of delta-narrowed revalidation (control/router.py): a link
+    #: flap re-routes a collective only when a dirtied switch is in
+    #: here. Empty = unknown (pre-index installs) -> always re-route.
+    switches: frozenset = frozenset()
 
     @property
     def signature(self) -> tuple:

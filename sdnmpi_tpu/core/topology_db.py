@@ -436,6 +436,33 @@ class TopologyDB:
         fdbs = [self.find_route(s, d) for s, d in pairs]
         return RouteWindow(result=WindowRoutes.from_fdbs(fdbs))
 
+    def find_routes_batch_delta_dispatch(self, pairs, dirty_dpids):
+        """Delta-narrowed split-phase routing (the churn dataflow's
+        re-scoring stage): like :meth:`find_routes_batch_dispatch` with
+        ``policy="shortest"``, but the oracle receives the dirtied
+        switch set as a device mask tensor and the reaped
+        ``WindowRoutes`` carries the per-pair ``touched`` verdict (new
+        path crosses the dirty set) for span-diff attribution. On the
+        JAX backend the refresh absorbs the delta log through the
+        in-place APSP repair; the pure-Python backend loops and
+        computes ``touched`` by set intersection — the differential
+        oracle for the narrowed revalidation path."""
+        if self.backend == "jax":
+            return self._jax_oracle().routes_batch_delta_dispatch(
+                self, pairs, dirty_dpids
+            )
+        from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
+
+        fdbs = [self.find_route(s, d) for s, d in pairs]
+        wr = WindowRoutes.from_fdbs(fdbs)
+        dirty = set(dirty_dpids)
+        import numpy as np
+
+        wr.touched = np.array(
+            [any(dpid in dirty for dpid, _ in fdb) for fdb in fdbs], bool
+        )
+        return RouteWindow(result=wr)
+
     def find_routes_collective(
         self,
         macs: list,

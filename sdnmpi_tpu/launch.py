@@ -125,6 +125,7 @@ def config_from_args(args) -> Config:
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
+        delta_reval=not getattr(args, "no_delta_reval", False),
         install_barriers=not getattr(args, "no_install_barriers", False),
         install_retry_max=getattr(args, "install_retry_max", 4),
         install_retry_backoff_s=getattr(args, "install_retry_backoff", 0.25),
@@ -335,6 +336,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the failure-domain recovery plane (desired-flow "
         "reconciliation, install retries, anti-entropy) — restores the "
         "fire-and-forget legacy for differential runs",
+    )
+    parser.add_argument(
+        "--no-delta-reval", action="store_true",
+        help="disable delta-narrowed flow revalidation: every topology "
+        "change re-routes EVERY installed flow and collective (the "
+        "differential escape hatch; narrowed and full passes leave "
+        "bit-identical FDB + desired state)",
     )
     parser.add_argument(
         "--no-install-barriers", action="store_true",

@@ -24,8 +24,22 @@ def bucket_len(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def bucket_pow2(n: int, floor: int = 8) -> int:
+    """Round a batch length up to the next power of two (floor 8) — the
+    coarse bucket tier of workloads whose batch sizes vary freely per
+    event. The delta-narrowed churn path uses this: every link flap
+    dirties a different number of flows, and multiple-of-8 buckets
+    would compile a fresh trace almost per flap, while pow2 buckets
+    bound the cache at log2(F) entries for the whole storm."""
+    out = max(8, floor)
+    while out < n:
+        out *= 2
+    return out
+
+
 def pad_flow_batch(
-    *arrays: np.ndarray, multiple: int = 8, fill: int = -1
+    *arrays: np.ndarray, multiple: int = 8, fill: int = -1,
+    pow2: bool = False,
 ) -> tuple[np.ndarray, ...]:
     """End-pad equal-length 1-D index arrays to a shared bucketed length.
 
@@ -36,10 +50,11 @@ def pad_flow_batch(
     value ``-1`` is the path kernels' "dead flow" marker (masked out of
     walks and reduces); end-padding keeps real rows' positions — and
     therefore their hash streams — unchanged, so callers just trim
-    outputs back to the true length.
+    outputs back to the true length. ``pow2`` selects the coarse
+    power-of-two bucket tier (see :func:`bucket_pow2`).
     """
     n = len(arrays[0])
-    padded = bucket_len(n, multiple)
+    padded = bucket_pow2(n, multiple) if pow2 else bucket_len(n, multiple)
     if padded == n:
         return arrays
     out = []
@@ -103,6 +118,12 @@ class WindowRoutes:
     max_congestion: float = 0.0
     #: pairs detoured through a Valiant intermediate (adaptive policy)
     n_detours: int = 0
+    #: [F] bool, set only by the delta-narrowed entry points
+    #: (``routes_batch_delta*``): True where the pair's NEW path crosses
+    #: the dirtied switch set — the drain-attribution bit of the
+    #: incremental churn dataflow (how many flows a flap pushed off the
+    #: failed region). None everywhere else.
+    touched: np.ndarray | None = None
 
     @property
     def n_pairs(self) -> int:

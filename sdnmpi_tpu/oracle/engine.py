@@ -54,6 +54,21 @@ def _dist_span(dist, src, dst, n):
 
 
 @jax.jit
+def _touched_rows(nodes, mask):
+    """[F] bool: does any valid hop of each -1-padded node row land in
+    the dirty-switch mask — the device half of the delta-narrowed
+    re-scoring entry point (``routes_batch_delta``). The mask is a [V]
+    bool tensor (fixed shape per topology capacity) and the node rows
+    arrive bucket-padded, so a storm of flap bursts with varying
+    affected-pair counts shares one compiled trace per bucket."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("delta_touched")
+    safe = jnp.maximum(nodes, 0)
+    return ((nodes >= 0) & mask[safe]).any(axis=1)
+
+
+@jax.jit
 def _gather_links(base, li, lj):
     """[E] per-link slice of a device-resident base-cost matrix (the
     DAG engine's util vector input) — the device twin of the host
@@ -834,9 +849,64 @@ class RouteOracle:
         """
         return self.routes_batch_dispatch(db, pairs).reap().fdbs()
 
+    @_timed_batch("routes_batch_delta")
+    def routes_batch_delta(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        dirty_dpids,
+    ):
+        """Blocking twin of :meth:`routes_batch_delta_dispatch` —
+        dispatch and reap back to back; returns the window's
+        :class:`~sdnmpi_tpu.oracle.batch.WindowRoutes` (``touched``
+        populated)."""
+        return self.routes_batch_delta_dispatch(db, pairs, dirty_dpids).reap()
+
+    @_timed_batch("routes_batch_delta_dispatch")
+    def routes_batch_delta_dispatch(
+        self,
+        db: "TopologyDB",
+        pairs: list[tuple[str, str]],
+        dirty_dpids,
+    ):
+        """Delta-narrowed re-scoring — the oracle leg of the incremental
+        churn dataflow (DeltaPath, PAPERS.md). ``pairs`` is the affected
+        subset a link flap dirtied (flows whose installed hops touch
+        ``dirty_dpids``); the ``refresh`` this entry point runs absorbs
+        the delta log through the in-place APSP repair
+        (oracle/incremental.py), so re-scoring a flap costs O(affected
+        pairs), never a full recompute. The dirtied switch set rides to
+        the device as a [V] bool mask tensor and each pair's NEW path is
+        tested against it on device (``_touched_rows``) — the reaped
+        :class:`~sdnmpi_tpu.oracle.batch.WindowRoutes` carries the
+        per-pair ``touched`` verdict feeding the control plane's
+        drain-attribution telemetry (how many flows a flap pushed off
+        the failed region). Batch
+        lengths are bucket-padded (oracle/batch.pad_flow_batch) and the
+        mask shape is the fixed [V], so a storm of flap bursts with
+        varying affected counts never retraces."""
+        t = self.refresh(db)  # delta log -> incremental repair
+        uniq = set(dirty_dpids)
+        dirty_idx = np.array(
+            sorted(t.index[d] for d in uniq if d in t.index), np.int32
+        )
+        dirty_dpid = np.array(sorted(uniq), np.int64)
+        return self.routes_batch_dispatch(
+            db, pairs, _dirty=(dirty_idx, dirty_dpid)
+        )
+
+    @staticmethod
+    def _host_touched(hop_dpid: np.ndarray, dirty_dpid: np.ndarray):
+        """[F] bool twin of the device ``_touched_rows`` for legs whose
+        hop rows already live on host (host chase, scalar fallbacks):
+        does the row's dpid sequence intersect the dirty set. -1 pads
+        can never be in the dirty set, so no validity mask is needed."""
+        return np.isin(hop_dpid, dirty_dpid).any(axis=1)
+
     @_timed_batch("routes_batch_dispatch")
     def routes_batch_dispatch(
-        self, db: "TopologyDB", pairs: list[tuple[str, str]]
+        self, db: "TopologyDB", pairs: list[tuple[str, str]],
+        _dirty=None,
     ):
         """Split-phase batch routing: launch the device extraction and
         return a :class:`~sdnmpi_tpu.oracle.batch.RouteWindow` whose
@@ -850,14 +920,26 @@ class RouteOracle:
         on this window's transfer. Small batches chase the cached
         next-hop matrix on the host with zero device round-trips and
         come back as already-completed windows.
+
+        ``_dirty`` is the delta entry point's ``(dirty row indices,
+        dirty dpids)`` pair (see :meth:`routes_batch_delta_dispatch`);
+        when set, the reaped window's ``touched`` array is populated —
+        on device for the batched leg, via :meth:`_host_touched`
+        otherwise.
         """
         from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
 
         t = self.refresh(db)
         results: list[list[tuple[int, int]]] = [[] for _ in pairs]
         rows = self._resolve_rows(db, pairs, t, results)
+
+        def _finish(wr: WindowRoutes) -> WindowRoutes:
+            if _dirty is not None:
+                wr.touched = self._host_touched(wr.hop_dpid, _dirty[1])
+            return wr
+
         if not rows:
-            return RouteWindow(result=WindowRoutes.from_fdbs(results))
+            return RouteWindow(result=_finish(WindowRoutes.from_fdbs(results)))
 
         src_idx = np.array([r[1] for r in rows], dtype=np.int32)
         dst_idx = np.array([r[2] for r in rows], dtype=np.int32)
@@ -865,7 +947,7 @@ class RouteOracle:
 
         max_len = self._batch_max_len(src_idx, dst_idx)
         if max_len == 0:
-            return RouteWindow(result=WindowRoutes.from_fdbs(results))
+            return RouteWindow(result=_finish(WindowRoutes.from_fdbs(results)))
 
         # small batches chase on host — but only when BOTH host twins
         # are already (or cheaply) materialized; the chase body reads
@@ -890,11 +972,16 @@ class RouteOracle:
                     node = nxt
                 fdb.append((int(dpids[di]), int(fport)))
                 results[k] = fdb
-            return RouteWindow(result=WindowRoutes.from_fdbs(results))
+            return RouteWindow(result=_finish(WindowRoutes.from_fdbs(results)))
 
         from sdnmpi_tpu.oracle.batch import pad_flow_batch
 
-        src_p, dst_p, fport_p = pad_flow_batch(src_idx, dst_idx, final_port)
+        # flap-burst sizes vary freely per delta, so the delta path
+        # buckets at the coarse pow2 tier: one compile per power of two
+        # for the whole storm instead of one per multiple-of-8 length
+        src_p, dst_p, fport_p = pad_flow_batch(
+            src_idx, dst_idx, final_port, pow2=_dirty is not None
+        )
         nodes_d, ports_d, length_d = batch_fdb(
             self._next_d,
             t.port,
@@ -903,6 +990,16 @@ class RouteOracle:
             jnp.asarray(fport_p),
             max_len,
         )
+        touched_d = None
+        if _dirty is not None:
+            # dirty set as a [V] bool mask tensor: the per-pair
+            # new-path-crosses-dirty verdict computes on device from the
+            # nodes already there (one gather-reduce), never by pulling
+            # hop rows back just to set-intersect them on host
+            mask = np.zeros(t.v, bool)
+            mask[_dirty[0]] = True
+            touched_d = _touched_rows(nodes_d, jnp.asarray(mask))
+            _start_host_copy(touched_d)
         _start_host_copy(nodes_d, ports_d, length_d)
         pair_rows = np.array([r[0] for r in rows], dtype=np.int64)
         n_pairs = len(pairs)
@@ -928,9 +1025,17 @@ class RouteOracle:
             op[pair_rows, : ports.shape[1]] = ports
             ln[pair_rows] = length
             wr = WindowRoutes(od, op, ln)
-            for k, fdb in enumerate(results):
-                if fdb:  # merge scalar fallbacks back in
-                    wr.set_fdb(k, fdb)
+            fallbacks = [k for k, fdb in enumerate(results) if fdb]
+            for k in fallbacks:  # merge scalar fallbacks back in
+                wr.set_fdb(k, results[k])
+            if touched_d is not None:
+                touched = np.zeros(n_pairs, bool)
+                touched[pair_rows] = np.asarray(touched_d)[:n_rows]
+                if fallbacks:  # host twin for the scalar-fallback rows
+                    touched[fallbacks] = self._host_touched(
+                        wr.hop_dpid[fallbacks], _dirty[1]
+                    )
+                wr.touched = touched
             return wr
 
         return RouteWindow(reap)

@@ -83,14 +83,25 @@ class TestRPCMirror:
         ]
 
     def test_fdb_removal_mirrored(self):
+        """A teardown BURST mirrors as ONE remove_fdb_batch (ISSUE 6);
+        per-row remove_fdb remains the single-removal shape (flow
+        expiry — see test_flow_expiry's wire assertions)."""
         fabric, controller, rpc = make_stack()
         client = FakeClient()
         rpc.attach_client(client)
         fabric.hosts[MAC[1]].send(ip_packet(MAC[1], MAC[4]))
         client.messages.clear()
         fabric.remove_link(2, 3, 4, 2)
-        assert "remove_fdb" in client.methods()
         assert "delete_link" in client.methods()
+        batches = [
+            m for m in client.messages if m["method"] == "remove_fdb_batch"
+        ]
+        assert len(batches) == 1
+        rows = batches[0]["params"][0]
+        assert len(rows) > 1  # the whole burst in one notification
+        assert all(
+            len(r) == 3 and r[1] == MAC[1] and r[2] == MAC[4] for r in rows
+        )
 
     def test_dead_client_dropped(self):
         fabric, controller, rpc = make_stack()

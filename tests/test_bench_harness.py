@@ -233,6 +233,105 @@ class TestJsonSchemaCheck:
         assert bench_run.check_rows(rows)
 
 
+class TestRegressionGate:
+    """--regression-gate FILE: fail when any (config, metric)'s
+    vs_baseline regresses more than 20% below the committed suite."""
+
+    BASE = [
+        {"config": "1", "metric": "m", "value": 1.0, "unit": "ms",
+         "vs_baseline": 4.0},
+        {"config": "2", "metric": "m", "value": 1.0, "unit": "ms",
+         "vs_baseline": 10.0},
+        {"config": "3", "error": "timeout"},
+    ]
+
+    def test_check_regression_rules(self):
+        rows = [
+            # within tolerance (3.3 >= 4.0 * 0.8)
+            {"config": "1", "metric": "m", "value": 1, "unit": "ms",
+             "vs_baseline": 3.3},
+            # regressed (7.9 < 10.0 * 0.8)
+            {"config": "2", "metric": "m", "value": 1, "unit": "ms",
+             "vs_baseline": 7.9},
+            # new metric: not in the committed file, never fails
+            {"config": "9", "metric": "new", "value": 1, "unit": "ms",
+             "vs_baseline": 0.1},
+            # error rows are the run gate's job, not this one's
+            {"config": "2", "error": "timeout"},
+        ]
+        errors = bench_run.check_regression(rows, self.BASE)
+        assert len(errors) == 1
+        assert "config 2" in errors[0] and "7.9" in errors[0]
+
+    def test_check_regression_improvements_pass(self):
+        rows = [{"config": "2", "metric": "m", "value": 1, "unit": "ms",
+                 "vs_baseline": 50.0}]
+        assert bench_run.check_regression(rows, self.BASE) == []
+
+    def test_cli_missing_gate_file_fails_before_running(self, monkeypatch):
+        ran = []
+        monkeypatch.setattr(bench_run, "run_suite",
+                            lambda *a, **k: ran.append(1) or [])
+        monkeypatch.setattr(
+            sys, "argv", ["run.py", "--regression-gate", "/nope.json"]
+        )
+        with pytest.raises(SystemExit) as e:
+            bench_run.main()
+        assert isinstance(e.value.code, str) and not ran
+
+    def test_cli_gates_run_results(self, tmp_path, monkeypatch):
+        """A run whose fresh vs_baseline dropped >20% vs the committed
+        file exits 1; within tolerance exits 0."""
+        import pathlib
+
+        gate = tmp_path / "committed.json"
+        monkeypatch.setattr(
+            pathlib.Path, "resolve", lambda self: tmp_path / "x" / "y"
+        )
+        # OK_CMD emits vs_baseline 2.0 for config "1"
+        for committed, want_code in ((2.2, 0), (4.0, 1)):
+            gate.write_text(json.dumps([
+                {"config": "1", "metric": "m", "value": 1.0, "unit": "ms",
+                 "vs_baseline": committed},
+            ]))
+            monkeypatch.setattr(bench_run, "CONFIGS", [("1", OK_CMD)])
+            monkeypatch.setattr(bench_run, "probe_backend",
+                                lambda timeout_s=0: (True, "ok"))
+            monkeypatch.setattr(sys, "argv", [
+                "run.py", "1", f"--regression-gate={gate}",
+            ])
+            with pytest.raises(SystemExit) as e:
+                bench_run.main()
+            assert e.value.code == want_code, (committed, e.value.code)
+
+    def test_cli_schema_check_plus_gate_runs_nothing(
+        self, tmp_path, monkeypatch
+    ):
+        import pathlib
+
+        ran = []
+        monkeypatch.setattr(bench_run, "run_suite",
+                            lambda *a, **k: ran.append(1) or [])
+        monkeypatch.setattr(
+            pathlib.Path, "resolve", lambda self: tmp_path / "x" / "y"
+        )
+        (tmp_path / "BENCH_suite.json").write_text(json.dumps([
+            {"config": "1", "metric": "m", "value": 1.0, "unit": "ms",
+             "vs_baseline": 1.0},
+        ]))
+        gate = tmp_path / "committed.json"
+        gate.write_text(json.dumps([
+            {"config": "1", "metric": "m", "value": 1.0, "unit": "ms",
+             "vs_baseline": 2.0},
+        ]))
+        monkeypatch.setattr(sys, "argv", [
+            "run.py", "--json-schema-check", f"--regression-gate={gate}",
+        ])
+        with pytest.raises(SystemExit) as e:
+            bench_run.main()
+        assert e.value.code == 1 and not ran  # on-disk suite regressed
+
+
 def test_partial_rerun_merges_not_clobbers(tmp_path):
     (tmp_path / "BENCH_suite.json").write_text(json.dumps([
         {"config": "1", "metric": "old1", "value": 9.0},

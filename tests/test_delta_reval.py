@@ -1,0 +1,568 @@
+"""End-to-end incremental churn dataflow (ISSUE 6).
+
+The contracts under test:
+
+- the delta-narrowed re-scoring entry point (``routes_batch_delta`` +
+  dispatch twin) routes exactly like the plain batch API, computes the
+  per-pair ``touched`` verdict identically on the device, host-chase,
+  and pure-Python legs, and holds its jit trace count flat across a
+  storm of varying flap-burst sizes (pow2 bucketing);
+- the seeded churn-replay differential fence: N flap steps on a
+  fat-tree and a torus leave the narrowed revalidation's final FDB,
+  switch flow tables, and PR-5 desired-flow store bit-identical to the
+  ``delta_reval=False`` full pass — in the simulated fabric and over
+  real wire bytes — while provably doing less oracle work;
+- narrowed revalidation runs through the PIPELINED dispatch/reap window
+  path (DispatchRoutesBatchRequest with the dirty set), not one
+  blocking batch request;
+- block-installed collectives re-route only when the dirty set
+  intersects the switches their blocks ride;
+- ``_reinstall_collective`` reinstalls only LIVE ranks (the dead-rank
+  leak regression);
+- teardown bursts publish ONE EventFDBRemoveBatch (with the per-row
+  compat shim and the RPC mirror's single broadcast);
+- ``OFSouthbound.flow_mods_window`` schedules per-switch slices
+  round-robin so one span cannot serialize the window, with per-switch
+  byte streams unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+from sdnmpi_tpu.topogen import fattree, torus
+
+
+def _stack(spec, wire=False, **config_kw):
+    config_kw.setdefault("enable_monitor", False)
+    fabric = spec.to_fabric(wire=wire)
+    controller = Controller(fabric, Config(**config_kw))
+    controller.attach()
+    return fabric, controller
+
+
+def _flow_state(fabric):
+    state = set()
+    for dpid, sw in fabric.switches.items():
+        for e in sw.flow_table:
+            if e.priority == 0x8000:
+                state.add((dpid, e.match, e.actions, e.priority))
+    return state
+
+
+def _desired_state(controller):
+    return {
+        dpid: dict(table)
+        for dpid, table in controller.router.recovery.desired.flows.items()
+        if table
+    }
+
+
+def _count_route_requests(controller):
+    counts = {"batch": 0, "dispatch": 0, "pairs": 0, "dirty": []}
+    for req_type, key in (
+        (ev.FindRoutesBatchRequest, "batch"),
+        (ev.DispatchRoutesBatchRequest, "dispatch"),
+    ):
+        handler = controller.bus._request_handlers[req_type]
+
+        def counting(req, handler=handler, key=key):
+            counts[key] += 1
+            counts["pairs"] += len(req.pairs)
+            if key == "dispatch":
+                counts["dirty"].append(getattr(req, "dirty", None))
+            return handler(req)
+
+        controller.bus._request_handlers[req_type] = counting
+    return counts
+
+
+# -- oracle: routes_batch_delta --------------------------------------------
+
+
+class TestRoutesBatchDelta:
+    def _db(self, backend="jax"):
+        return fattree(4).to_topology_db(backend=backend)
+
+    def _pairs(self, db, n=10):
+        macs = sorted(db.hosts)
+        pairs = [(macs[i], macs[(i * 5 + 3) % len(macs)]) for i in range(n)]
+        return [(s, d) for s, d in pairs if s != d]
+
+    def _dirty(self, db):
+        a = sorted(db.links)[0]
+        b = sorted(db.links[a])[0]
+        return {a, b}
+
+    def test_routes_match_plain_batch_and_touched_is_exact(self):
+        db = self._db()
+        pairs = self._pairs(db)
+        dirty = self._dirty(db)
+        wr = db.find_routes_batch_delta_dispatch(pairs, dirty).reap()
+        assert wr.fdbs() == db.find_routes_batch(pairs)
+        want = [
+            any(dpid in dirty for dpid, _ in fdb) for fdb in wr.fdbs()
+        ]
+        assert wr.touched.tolist() == want
+        assert any(want) and not all(want)  # the fixture exercises both
+
+    def test_device_host_and_py_legs_agree(self):
+        db = self._db()
+        pairs = self._pairs(db)
+        dirty = self._dirty(db)
+        host = db.find_routes_batch_delta_dispatch(pairs, dirty).reap()
+        oracle = db._jax_oracle()
+        oracle.host_chase_hop_budget = 0  # force the device leg
+        dev = oracle.routes_batch_delta(db, pairs, dirty)
+        pydb = self._db(backend="py")
+        py = pydb.find_routes_batch_delta_dispatch(pairs, dirty).reap()
+        assert host.fdbs() == dev.fdbs() == py.fdbs()
+        assert host.touched.tolist() == dev.touched.tolist() == (
+            py.touched.tolist()
+        )
+
+    def test_unresolvable_and_empty_batches_carry_touched(self):
+        db = self._db()
+        wr = db.find_routes_batch_delta_dispatch([], self._dirty(db)).reap()
+        assert wr.touched.tolist() == []
+        wr = db.find_routes_batch_delta_dispatch(
+            [("aa:bb:cc:dd:ee:ff", "ff:ee:dd:cc:bb:aa")], self._dirty(db)
+        ).reap()
+        assert wr.fdbs() == [[]]
+        assert wr.touched.tolist() == [False]
+
+    def test_flap_storm_never_retraces_per_flap(self):
+        """The trace-count bound: after the warm flap, a storm of
+        deltas with VARYING affected-batch sizes inside one pow2 bucket
+        must not trace the delta kernels again — churn must not
+        recompile."""
+        from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+        db = self._db()
+        oracle = db._jax_oracle()
+        oracle.host_chase_hop_budget = 0  # keep the device leg honest
+        pairs = self._pairs(db, n=14)
+        cables = [
+            (db.links[a][b], db.links[b][a])
+            for a in sorted(db.links) for b in sorted(db.links[a]) if a < b
+        ]
+        warm = cables[0]
+        dirty = {warm[0].src.dpid, warm[0].dst.dpid}
+        for lk in warm:
+            db.delete_link(lk)
+        oracle.routes_batch_delta(db, pairs[:9], dirty)  # warm: bucket 16
+        for lk in warm:
+            db.add_link(lk)
+        oracle.routes_batch_delta(db, pairs[:9], dirty)
+        TRACE_COUNTS.clear()
+        rng = np.random.default_rng(7)
+        for i in range(6):
+            cable = cables[int(rng.integers(1, len(cables)))]
+            dirty = {cable[0].src.dpid, cable[0].dst.dpid}
+            for lk in cable:
+                db.delete_link(lk)
+            # 9..14 pairs: different lengths, same pow2 bucket (16)
+            oracle.routes_batch_delta(db, pairs[: 9 + (i % 6)], dirty)
+            for lk in cable:
+                db.add_link(lk)
+            oracle.routes_batch_delta(db, pairs[: 9 + ((i + 3) % 6)], dirty)
+        assert TRACE_COUNTS["delta_touched"] == 0
+        assert TRACE_COUNTS["batch_fdb"] == 0
+        assert TRACE_COUNTS["batch_paths"] == 0
+
+    def test_pow2_bucketing(self):
+        from sdnmpi_tpu.oracle.batch import bucket_pow2, pad_flow_batch
+
+        assert [bucket_pow2(n) for n in (1, 8, 9, 16, 17, 100)] == [
+            8, 8, 16, 16, 32, 128,
+        ]
+        (a,) = pad_flow_batch(np.arange(9, dtype=np.int32), pow2=True)
+        assert len(a) == 16 and a[9:].tolist() == [-1] * 7
+
+
+# -- the seeded churn-replay differential fence ----------------------------
+
+
+def _install_traffic(fabric, controller, seed=3, n_pairs=12):
+    """Install a deterministic population of unicast + MPI flows."""
+    macs = sorted(fabric.hosts)
+    rng = np.random.default_rng(seed)
+    pairs = set()
+    while len(pairs) < min(n_pairs, len(macs) * (len(macs) - 1) // 2):
+        i, j = rng.integers(0, len(macs), 2)
+        if i != j:
+            pairs.add((macs[int(i)], macs[int(j)]))
+    for src, dst in sorted(pairs):
+        fabric.hosts[src].send(of.Packet(src, dst, payload=b"x"))
+    # two ranks + one vMAC flow so last-hop rewrites ride the fence too
+    for mac, rank in ((macs[0], 0), (macs[-1], 1)):
+        fabric.hosts[mac].send(of.Packet(
+            mac, "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        ))
+    vmac = VirtualMac(CollectiveType.P2P, 0, 1).encode()
+    fabric.hosts[macs[0]].send(of.Packet(macs[0], vmac, payload=b"m"))
+    assert controller.router.fdb.entries()
+
+
+SPECS = {
+    "fattree4": lambda: fattree(4),
+    "torus3x3": lambda: torus((3, 3)),
+}
+
+
+class TestChurnReplayFence:
+    @pytest.mark.parametrize("wire", [False, True], ids=["sim", "wire"])
+    @pytest.mark.parametrize("topo", sorted(SPECS))
+    def test_narrowed_matches_full_pass_bit_identically(self, topo, wire):
+        """N seeded flap steps: after quiesce the narrowed stack's FDB,
+        switch flow tables, and desired-flow store equal the
+        ``delta_reval=False`` stack's exactly — while having examined
+        strictly fewer pairs (the narrowing must not be vacuous)."""
+        spec = SPECS[topo]()
+        narrowed = _stack(spec, wire=wire)
+        full = _stack(spec, wire=wire, delta_reval=False)
+        for fabric, controller in (narrowed, full):
+            _install_traffic(fabric, controller)
+        counts = [_count_route_requests(c) for _, c in (narrowed, full)]
+
+        cables = sorted(spec.links)
+        rng = np.random.default_rng(11)
+        removed = None
+        for step in range(8):
+            if removed is None:
+                removed = cables[int(rng.integers(0, len(cables)))]
+                for fabric, _ in (narrowed, full):
+                    fabric.remove_link(*removed)
+            else:
+                for fabric, controller in (narrowed, full):
+                    fabric.add_link(*removed)
+                    controller.bus.publish(ev.EventTopologyChanged())
+                removed = None
+            # the fence holds after EVERY step, not just at the end
+            assert set(narrowed[1].router.fdb.entries()) == set(
+                full[1].router.fdb.entries()
+            ), f"FDB diverged at step {step}"
+        assert _flow_state(narrowed[0]) == _flow_state(full[0])
+        assert _desired_state(narrowed[1]) == _desired_state(full[1])
+        # narrowing did strictly less oracle work than the full pass
+        assert counts[0]["pairs"] < counts[1]["pairs"]
+
+    def test_escape_hatch_full_pass_examines_everything(self):
+        """delta_reval=False must re-route every installed pair on a
+        disjoint delete that the narrowed pass skips entirely."""
+        spec = fattree(4)
+        narrowed = _stack(spec)
+        full = _stack(spec, delta_reval=False)
+        for fabric, controller in (narrowed, full):
+            _install_traffic(fabric, controller)
+        counts = [_count_route_requests(c) for _, c in (narrowed, full)]
+        for fabric, controller in (narrowed, full):
+            fabric.remove_link(*sorted(spec.links)[0])
+            fabric.add_link(*sorted(spec.links)[0])
+            controller.bus.publish(ev.EventTopologyChanged())
+        assert counts[1]["pairs"] >= counts[0]["pairs"]
+        assert counts[1]["batch"] + counts[1]["dispatch"] >= 2
+
+
+class TestPipelinedRevalidation:
+    def test_narrowed_pass_uses_dispatch_windows_with_dirty(self):
+        """Surviving-flow re-scoring must ride the split-phase window
+        path, chunked at coalesce_max_batch, with the dirty set on the
+        request — not one blocking FindRoutesBatchRequest."""
+        spec = fattree(4)
+        fabric, controller = _stack(spec, coalesce_max_batch=2)
+        _install_traffic(fabric, controller, n_pairs=8)
+        counts = _count_route_requests(controller)
+        # seed the reval baseline, then delete a heavily-ridden cable
+        controller.bus.publish(ev.EventTopologyChanged())
+        counts["dispatch"] = counts["batch"] = 0
+        counts["dirty"].clear()
+        # pick the cable most installed flows ride
+        from collections import Counter
+
+        load = Counter()
+        for dpid, src, dst, port in controller.router.fdb.entries():
+            load[dpid] += 1
+        dpid = load.most_common(1)[0][0]
+        cable = next(
+            link for link in sorted(spec.links) if dpid in (link[0], link[2])
+        )
+        fabric.remove_link(*cable)
+        assert counts["dispatch"] >= 2  # chunked windows, not one call
+        assert counts["batch"] == 0
+        assert all(d is not None and d for d in counts["dirty"])
+
+    def test_serial_escape_hatch_stays_blocking(self):
+        spec = fattree(4)
+        fabric, controller = _stack(spec, pipelined_install=False)
+        _install_traffic(fabric, controller, n_pairs=6)
+        counts = _count_route_requests(controller)
+        controller.bus.publish(ev.EventTopologyChanged())
+        counts["dispatch"] = counts["batch"] = 0
+        fabric.remove_link(*sorted(spec.links)[0])
+        assert counts["dispatch"] == 0  # scalar leg: no split-phase
+
+
+# -- collective narrowing + dead-rank regression ---------------------------
+
+
+def _block_stack(**config_kw):
+    spec = fattree(4)
+    config_kw.setdefault("block_install_threshold", 2)
+    fabric, controller = _stack(spec, **config_kw)
+    macs = sorted(fabric.hosts)[:4]
+    for rank, mac in enumerate(macs):
+        fabric.hosts[mac].send(of.Packet(
+            mac, "ff:ff:ff:ff:ff:ff", ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        ))
+    vmac = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+    fabric.hosts[macs[0]].send(of.Packet(macs[0], vmac, payload=b"m"))
+    assert len(controller.router.collectives) == 1
+    return spec, fabric, controller, macs
+
+
+class TestCollectiveNarrowing:
+    def test_install_records_ridden_switches(self):
+        _, _, controller, _ = _block_stack()
+        install = next(iter(controller.router.collectives))
+        assert install.switches
+        # every recorded switch is a real dpid of the fabric
+        assert install.switches <= set(controller.router.dps)
+
+    def test_disjoint_flap_skips_reinstall_dirty_flap_reroutes(self):
+        spec, fabric, controller, _ = _block_stack()
+        install = next(iter(controller.router.collectives))
+        reinstalls = []
+        controller.bus.subscribe(
+            ev.EventCollectiveInstalled, reinstalls.append
+        )
+        controller.bus.publish(ev.EventTopologyChanged())  # baseline
+        reinstalls.clear()
+        # a cable none of the collective's blocks ride
+        spare = next(
+            link for link in sorted(spec.links)
+            if link[0] not in install.switches
+            and link[2] not in install.switches
+        )
+        fabric.remove_link(*spare)
+        assert reinstalls == []  # disjoint: skipped
+        ridden = next(
+            link for link in sorted(spec.links)
+            if link[0] in install.switches or link[2] in install.switches
+        )
+        fabric.remove_link(*ridden)
+        assert len(reinstalls) == 1  # dirty: re-routed
+
+    def test_reinstall_drops_dead_ranks(self):
+        """The dead-rank leak regression: a reinstall after a rank
+        vanished must install only the live subset — remapped pairs, no
+        flows to the dead rank's vMACs, and a truthful record."""
+        _, fabric, controller, macs = _block_stack()
+        router = controller.router
+        install = next(iter(router.collectives))
+        assert install.ranks == (0, 1, 2, 3)
+        # rank 2's process vanishes from the rankdb without a teardown
+        # event (the restore / stale-table path the leak lived on)
+        rankdb = controller.bus.request(
+            ev.CurrentProcessAllocationRequest()
+        ).processes
+        rankdb.delete_process(2)
+        router._remove_collective(install)
+        router._reinstall_collective(install)
+        fresh = next(iter(router.collectives))
+        assert fresh.ranks == (0, 1, 3)
+        assert fresh.n_pairs == 6  # 3 live ranks alltoall, not 12
+        dead_vmacs = {
+            VirtualMac(CollectiveType.ALLTOALL, s, d).encode()
+            for s, d in [(2, r) for r in range(4)] + [(r, 2) for r in range(4)]
+        }
+        for sw in fabric.switches.values():
+            for entry in sw.block_table:
+                from sdnmpi_tpu.utils.mac import int_to_mac
+
+                blk = entry.block
+                for key in np.asarray(blk.dst):
+                    assert int_to_mac(int(key)) not in dead_vmacs
+
+    def test_reinstall_noop_when_too_few_live(self):
+        _, _, controller, _ = _block_stack()
+        router = controller.router
+        install = next(iter(router.collectives))
+        rankdb = controller.bus.request(
+            ev.CurrentProcessAllocationRequest()
+        ).processes
+        for rank in (1, 2, 3):
+            rankdb.delete_process(rank)
+        router._remove_collective(install)
+        router._reinstall_collective(install)
+        assert len(router.collectives) == 0
+
+
+# -- batched FDB-remove events ---------------------------------------------
+
+
+class TestFDBRemoveBatch:
+    def _partition(self, fabric, controller):
+        counts = {"batch": [], "row": []}
+        controller.bus.subscribe(
+            ev.EventFDBRemoveBatch, counts["batch"].append
+        )
+        controller.bus.subscribe(ev.EventFDBRemove, counts["row"].append)
+        # cut every cable of the most-ridden switch: the crossing flows
+        # tear down as one burst
+        from collections import Counter
+
+        load = Counter()
+        for dpid, src, dst, port in controller.router.fdb.entries():
+            load[dpid] += 1
+        dpid = load.most_common(1)[0][0]
+        for link in [
+            l for l in sorted(fabric.links) if dpid in (l[0], l[2])
+        ]:
+            fabric.remove_link(*link)
+        return counts
+
+    def test_revalidation_burst_is_one_batch_event(self):
+        spec = fattree(4)
+        fabric, controller = _stack(spec)
+        _install_traffic(fabric, controller)
+        counts = self._partition(fabric, controller)
+        batched = sum(len(e.rows) for e in counts["batch"])
+        assert batched > 1
+        # bursts never leave per-row (a lone row may — that is the
+        # contract, not a leak)
+        assert len(counts["row"]) <= 1
+
+    def test_compat_shim_expands_batches_per_row(self):
+        spec = fattree(4)
+        fabric, controller = _stack(spec)
+        _install_traffic(fabric, controller)
+        rows = []
+        ev.subscribe_fdb_removes(
+            controller.bus, lambda e: rows.append((e.dpid, e.src, e.dst))
+        )
+        counts = self._partition(fabric, controller)
+        want = sum(len(e.rows) for e in counts["batch"]) + len(counts["row"])
+        assert len(rows) == want > 1
+
+    def test_rank_exit_is_one_batch_and_rpc_broadcast(self):
+        from sdnmpi_tpu.api.rpc import RPCInterface
+
+        spec = fattree(4)
+        fabric, controller = _stack(spec)
+        rpc = RPCInterface(controller.bus, controller.config)
+
+        class Client:
+            def __init__(self):
+                self.messages = []
+
+            def send_json(self, message):
+                self.messages.append(message)
+
+        client = Client()
+        rpc.attach_client(client)
+        _install_traffic(fabric, controller)
+        client.messages.clear()
+        controller.bus.publish(ev.EventProcessDelete(1))
+        removes = [
+            m for m in client.messages
+            if m.get("method") in ("remove_fdb", "remove_fdb_batch")
+        ]
+        assert len(removes) == 1
+        assert removes[0]["method"] == "remove_fdb_batch"
+        assert len(removes[0]["params"][0]) > 1
+
+
+# -- southbound per-switch send scheduling ---------------------------------
+
+
+class TestWindowSendScheduling:
+    def _southbound(self, captured):
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        sb._writers = {1: object(), 2: object()}
+        sb.send_barriers = False
+
+        def send(dpid, payload):
+            captured.append((dpid, bytes(payload)))
+            return True
+
+        sb._send = send
+        return sb
+
+    def _window(self, n_big, n_small):
+        dpids = np.array([1] * n_big + [2] * n_small, np.int64)
+        batch = of.FlowModBatch(
+            src=np.arange(n_big + n_small, dtype=np.int64),
+            dst=np.arange(n_big + n_small, dtype=np.int64) + 100,
+            out_port=np.ones(n_big + n_small, np.int32),
+        )
+        return dpids, batch
+
+    def test_slices_interleave_round_robin(self):
+        """One switch's giant span must not fully enqueue before the
+        other switch sees its first byte."""
+        sent = []
+        sb = self._southbound(sent)
+        sb.install_highwater = 80  # one 80-byte message per slice
+        dpids, batch = self._window(6, 2)
+        verdict = sb.flow_mods_window(dpids, batch)
+        assert verdict.sent == [1, 2] and not verdict.dropped
+        order = [d for d, _ in sent]
+        # switch 2's first slice lands in round 1, not after all of 1's
+        assert order[:4] == [1, 2, 1, 2]
+        assert order.count(1) == 6 and order.count(2) == 2
+
+    def test_per_switch_byte_streams_unchanged(self):
+        """Interleaving must not change what each switch reads: the
+        concatenated slices equal the switch's span of a contiguous
+        encode (byte-identical wire per peer)."""
+        from sdnmpi_tpu.protocol import ofwire
+        from sdnmpi_tpu.utils.arrays import group_spans
+
+        sent = []
+        sb = self._southbound(sent)
+        sb.install_highwater = 100
+        dpids, batch = self._window(5, 3)
+        ref_blob, ref_offsets = ofwire.encode_flow_mods_spans(
+            batch, xid_base=1
+        )
+        sb.flow_mods_window(dpids, batch)
+        for lo, hi in group_spans(dpids):
+            dpid = int(dpids[lo])
+            got = b"".join(p for d, p in sent if d == dpid)
+            assert got == ref_blob[int(ref_offsets[lo]):int(ref_offsets[hi])]
+
+    def test_cut_peer_does_not_starve_others(self):
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        sb._writers = {1: object(), 2: object()}
+        sb.send_barriers = True
+        sent = []
+
+        def send(dpid, payload):
+            if dpid == 1:
+                return False  # stalled-peer cut mid-window
+            sent.append((dpid, bytes(payload)))
+            return True
+
+        sb._send = send
+        sb.install_highwater = 80
+        dpids, batch = self._window(4, 3)
+        verdict = sb.flow_mods_window(dpids, batch)
+        assert verdict.dropped == [1]
+        assert verdict.sent == [2]
+        assert len(verdict.barriers) == 1 and verdict.barriers[0][0] == 2
+        # switch 2 got its whole span + barrier despite 1's cut
+        assert len([1 for d, _ in sent if d == 2]) == 4  # 3 slices + barrier
