@@ -13,8 +13,12 @@ import numpy as np
 # exit hook here gives each run a registry exposition next to its bench
 # JSON without per-config plumbing.
 from sdnmpi_tpu.api.telemetry import install_env_dump_hook
+from sdnmpi_tpu.utils.flight import (
+    install_env_dump_hook as install_flight_dump_hook,
+)
 
 install_env_dump_hook()
+install_flight_dump_hook()
 
 
 def log(msg: str) -> None:

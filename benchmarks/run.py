@@ -84,6 +84,7 @@ def run_suite(
     probe=probe_backend,
     suite_name: str = "BENCH_suite.json",
     metrics_dump: bool = False,
+    flight_dump: bool = False,
 ) -> list[dict]:
     """Run ``configs`` (list of (name, cmd)); flush the suite file after
     each one; fail the remainder fast if the backend probe says the
@@ -130,6 +131,15 @@ def run_suite(
 
             env = dict(os.environ)
             env[DUMP_ENV] = str(root / f"BENCH_metrics_{name}.prom")
+        if flight_dump:
+            # each config subprocess leaves its flight-recorder bundles
+            # (anomaly-trigger diagnostics: span trees, metrics deltas,
+            # window census) beside the bench JSON — the triage loop
+            # for a bench row whose p99 went sideways (ISSUE 7)
+            from sdnmpi_tpu.utils.flight import DUMP_ENV as FLIGHT_ENV
+
+            env = dict(os.environ) if env is None else env
+            env[FLIGHT_ENV] = str(root / f"BENCH_flight_{name}.json")
         try:
             proc = subprocess.run(
                 cmd, cwd=root, capture_output=True, text=True,
@@ -294,11 +304,14 @@ def main() -> None:
             args = args[:i] + args[i + 1 :]
             break
     flags = {a for a in args if a.startswith("--")}
-    if unknown_flags := flags - {"--json-schema-check", "--metrics-dump"}:
+    if unknown_flags := flags - {
+        "--json-schema-check", "--metrics-dump", "--flight-dump"
+    }:
         # a typo'd flag must not silently launch the full TPU suite
         sys.exit(f"unknown flag(s) {sorted(unknown_flags)}")
     schema_only = "--json-schema-check" in flags
     metrics_dump = "--metrics-dump" in flags
+    flight_dump = "--flight-dump" in flags
     gate_rows = _load_gate(gate_path) if gate_path is not None else None
     only = {a for a in args if not a.startswith("--")}
     known = {name for name, _ in CONFIGS}
@@ -325,7 +338,10 @@ def main() -> None:
             print(e, file=sys.stderr)
         print(f"json-schema-check: {len(errors)} violation(s)")
         sys.exit(1 if errors else 0)
-    results = run_suite(CONFIGS, root, only, metrics_dump=metrics_dump)
+    results = run_suite(
+        CONFIGS, root, only, metrics_dump=metrics_dump,
+        flight_dump=flight_dump,
+    )
     failed = [r for r in results if "error" in r]
     # post-run gate: whatever just landed must also be well-formed...
     errors = check_rows(results)

@@ -97,6 +97,14 @@ class RPCInterface:
         # as the Prometheus text exposition (ISSUE 4)
         if config.rpc_telemetry:
             bus.subscribe(ev.EventStatsFlush, self._telemetry_flush)
+        # anomaly push channel (ISSUE 7): a flight-recorder trigger's
+        # frozen bundle summary broadcasts the moment it fires — the
+        # "something just went wrong, here is the dump path" signal
+        bus.subscribe(
+            ev.EventAnomaly,
+            lambda e: self._broadcast("anomaly", e.trigger, e.summary,
+                                      e.path),
+        )
 
     # -- client lifecycle -------------------------------------------------
 
@@ -136,6 +144,78 @@ class RPCInterface:
             snap = telemetry_snapshot()
         self._broadcast("update_telemetry", snap)
 
+    # -- pull-mode requests (ISSUE 7) --------------------------------------
+    #
+    # Beside the push broadcasts, a client may send JSON-RPC *requests*
+    # (messages WITH an id) and get replies: the pull half the ROADMAP's
+    # PR-4 carry-over asked for. Methods:
+    #
+    #   telemetry()          -> the registry snapshot (same payload as
+    #                           the update_telemetry push)
+    #   span_tree(span_id)   -> the flight recorder's completed tree
+    #                           containing that span (exemplar
+    #                           resolution), or null
+    #   flight_dump()        -> freeze + return a diagnostic bundle NOW
+
+    #: method name -> (request factory, reply-attribute extractor)
+    PULL_METHODS = {
+        "telemetry": (lambda params: ev.TelemetryRequest(),
+                      lambda reply: reply.telemetry),
+        "span_tree": (lambda params: ev.SpanTreeRequest(int(params[0])),
+                      lambda reply: reply.tree),
+        "flight_dump": (lambda params: ev.FlightDumpRequest(),
+                        lambda reply: reply.bundle),
+    }
+
+    def handle_request(self, message: dict):
+        """Answer one inbound JSON-RPC message. Returns the reply dict
+        for requests (id present), None for notifications (the
+        reference's clients never send any — tolerated, ignored).
+        Errors use the standard JSON-RPC codes so a stock client
+        library's error handling just works."""
+        if not isinstance(message, dict):
+            return None
+        msg_id = message.get("id")
+        if msg_id is None:
+            return None  # notification: nothing to answer
+        method = message.get("method")
+        entry = self.PULL_METHODS.get(method)
+        if entry is None:
+            return {
+                "jsonrpc": "2.0", "id": msg_id,
+                "error": {"code": -32601,
+                          "message": f"method not found: {method}"},
+            }
+        make_request, extract = entry
+        try:
+            request = make_request(message.get("params") or [])
+        except (LookupError, TypeError, ValueError) as e:
+            # built OUTSIDE the dispatch try: a missing positional
+            # (IndexError) or by-name params the factory doesn't take
+            # (KeyError — dict params are legal JSON-RPC 2.0) must read
+            # as bad params, not as a missing provider or a dead socket
+            return {
+                "jsonrpc": "2.0", "id": msg_id,
+                "error": {"code": -32602, "message": f"bad params: {e}"},
+            }
+        try:
+            reply = self.bus.request(request)
+            result = extract(reply)
+        except LookupError:
+            # minimal buses without the provider: telemetry falls back
+            # to the process registry; the rest report unavailable
+            if method == "telemetry":
+                from sdnmpi_tpu.api.telemetry import telemetry_snapshot
+
+                result = telemetry_snapshot()
+            else:
+                return {
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "error": {"code": -32001,
+                              "message": f"{method} unavailable"},
+                }
+        return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
     # -- broadcasting -----------------------------------------------------
 
     def _call(self, client: RPCClient, method: str, *params) -> bool:
@@ -173,7 +253,7 @@ class RPCInterface:
             interface.attach_client(client)
             log.info("RPC client connected")
             try:
-                await client.pump()
+                await client.pump(interface)
             finally:
                 interface.detach_client(client)
                 log.info("RPC client disconnected")
@@ -223,10 +303,54 @@ class _WebSocketClient:
             )
             raise ConnectionError("websocket client stalled; backlog full")
 
-    async def pump(self) -> None:
+    async def pump(self, interface=None) -> None:
+        """Drain the outbound queue and (when given the interface) serve
+        inbound pull-mode requests, until the socket dies. Replies ride
+        the same outbound queue as broadcasts — one writer task per
+        socket, so frames never interleave — and count against the same
+        backlog bound."""
+        import asyncio
+
+        tasks = [asyncio.create_task(self._send_loop())]
+        if interface is not None:
+            tasks.append(asyncio.create_task(self._recv_loop(interface)))
         try:
-            while True:
-                await self.ws.send(await self.queue.get())
+            done, pending = await asyncio.wait(
+                tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in pending:
+                task.cancel()
+            for task in done:
+                task.result()  # surface the failure like the old pump
         except Exception:
             self.closed = True
             raise
+        finally:
+            for task in tasks:
+                task.cancel()
+
+    async def _send_loop(self) -> None:
+        while True:
+            await self.ws.send(await self.queue.get())
+
+    async def _recv_loop(self, interface) -> None:
+        import asyncio
+
+        async for raw in self.ws:
+            try:
+                message = json.loads(raw)
+            except json.JSONDecodeError:
+                continue  # garbage frame: drop, keep the connection
+            reply = interface.handle_request(message)
+            if reply is not None:
+                # same last-resort encoder the disk dump uses: a bundle
+                # context value (numpy scalar, set) must not kill the
+                # socket when the file path survives it
+                from sdnmpi_tpu.utils.flight import json_default
+
+                try:
+                    self.queue.put_nowait(
+                        json.dumps(reply, default=json_default)
+                    )
+                except asyncio.QueueFull:
+                    return  # stalled peer: let pump tear us down

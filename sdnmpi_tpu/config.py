@@ -236,10 +236,45 @@ class Config:
     #: 0 disables.
     lldp_reprobe_interval: float = 15.0
 
+    # --- congestion analytics (oracle/utilplane.py; ISSUE 7) --------------
+    #: hottest directed links decoded per Monitor flush by the jitted
+    #: device top-k pass (the CongestionReportRequest payload and the
+    #: per-collective attribution input). Static jit argument — keep it
+    #: stable within a process.
+    congestion_topk: int = 8
+
+    # --- flight recorder (utils/flight.py; ISSUE 7) -----------------------
+    #: arm the in-memory flight recorder: the last N completed span
+    #: trees + a rolling registry-snapshot window + a bus-event tail,
+    #: with anomaly triggers freezing diagnostic bundles. Arming also
+    #: arms per-bucket histogram exemplars (a latency spike's bucket
+    #: resolves to the span tree of its latest observation). False
+    #: restores the PR-4 posture: spans exist only with --trace-log.
+    flight_recorder: bool = True
+    #: completed span trees the recorder retains (bounded ring)
+    flight_max_trees: int = 64
+    #: directory diagnostic bundles are dumped to as JSON files
+    #: ("" = keep bundles in memory only; the pull-mode ``flight_dump``
+    #: RPC and the bench --flight-dump hook still see them)
+    flight_dump_dir: str = ""
+    #: histogram-threshold anomaly trigger: a fresh observation of any
+    #: route/install/re-route latency histogram (install_e2e_seconds,
+    #: reval_*_seconds, barrier_rtt_seconds) provably at/above this
+    #: many seconds freezes a bundle. 0 disables the latency trigger.
+    flight_latency_threshold_s: float = 0.0
+    #: p99-regression anomaly trigger: the last Monitor interval's
+    #: estimated p99 of those histograms exceeding factor x the rolling
+    #: baseline freezes a bundle. 0 disables.
+    flight_p99_factor: float = 0.0
+
     # --- tracing / profiling (SURVEY §5: reference has none) -------------
     #: JSONL structured trace log path ("" = disabled); records oracle
     #: invocations with wall times (utils/tracing.py)
     trace_log: str = ""
+    #: Perfetto / chrome://tracing JSON written on shutdown from an
+    #: in-memory span collector (api/traceview.py) — the span trees on
+    #: a real timeline. "" = disabled.
+    trace_dump: str = ""
     #: JSONL control-plane event log ("" = disabled): every bus event as
     #: one JSON line via a bus tap (utils/event_log.py) — the full
     #: causal record, the fourth observability channel beyond the

@@ -95,6 +95,56 @@ class Controller:
             )
             self.bus.tap(self.event_logger)
 
+        # flight recorder (ISSUE 7): bounded span-tree ring + snapshot
+        # window + event tail, with anomaly triggers freezing diagnostic
+        # bundles. Wired LAST so its per-EventStatsFlush trigger pass
+        # observes the same pass's utilization flush, anti-entropy tick,
+        # and recovery counters (bus handlers run in subscription order).
+        self.flight = None
+        if config.flight_recorder:
+            from sdnmpi_tpu.utils.flight import (
+                FlightRecorder,
+                HistogramThreshold,
+                P99Regression,
+            )
+
+            flight = FlightRecorder(
+                max_trees=config.flight_max_trees,
+                dump_dir=config.flight_dump_dir,
+            )
+            # escalations/timeouts: every increment is an incident
+            flight.add_counter_triggers()
+            for hist in self.LATENCY_HISTOGRAMS:
+                if config.flight_latency_threshold_s > 0:
+                    flight.triggers.append(HistogramThreshold(
+                        hist, config.flight_latency_threshold_s
+                    ))
+                if config.flight_p99_factor > 0:
+                    flight.triggers.append(P99Regression(
+                        hist, factor=config.flight_p99_factor
+                    ))
+            flight.add_context("topology", self._topology_forensics)
+            flight.add_context("windows", self.router.window_census)
+            flight.on_anomaly = self._publish_anomaly
+            flight.arm()
+            self.bus.tap(flight.event_tap)
+            self.bus.subscribe(
+                ev.EventStatsFlush, lambda e: flight.snapshot_tick()
+            )
+            self.flight = flight
+        self.bus.provide(ev.SpanTreeRequest, self._span_tree)
+        self.bus.provide(ev.FlightDumpRequest, self._flight_dump)
+
+    #: the route/install/re-route latency histograms the flight
+    #: recorder's latency/p99 triggers watch (ISSUE 7)
+    LATENCY_HISTOGRAMS = (
+        "install_e2e_seconds",
+        "pipeline_install_seconds",
+        "reval_rescore_seconds",
+        "reval_install_seconds",
+        "barrier_rtt_seconds",
+    )
+
     def attach(self) -> None:
         """Connect the southbound fabric and replay discovery."""
         self.southbound.connect(self.bus)
@@ -102,13 +152,77 @@ class Controller:
     def telemetry(self) -> dict:
         """One snapshot of the control-plane telemetry: the process-wide
         metrics registry (counters/gauges/histograms, the jit-trace
-        family) plus the oracle wall-time summary. The RPC mirror
-        broadcasts exactly this dict as ``update_telemetry`` and the
-        Prometheus exposition (api/telemetry.py) renders exactly this
-        dict — one registry, two encodings, no chance of drift."""
+        family) plus the oracle wall-time summary and the latest
+        congestion-analytics report. The RPC mirror broadcasts exactly
+        this dict as ``update_telemetry`` and the Prometheus exposition
+        (api/telemetry.py) renders exactly this dict — one registry,
+        two encodings, no chance of drift."""
+        from sdnmpi_tpu.control import events as ev
         from sdnmpi_tpu.api.telemetry import telemetry_snapshot
 
         # the event log's own figures (event_log_events_total,
         # event_log_rotations_total) already live in the registry —
         # no hand-injected duplicates to reconcile
-        return telemetry_snapshot()
+        snap = telemetry_snapshot()
+        try:
+            report = self.bus.request(ev.CongestionReportRequest()).report
+        except LookupError:  # duck-typed minimal stacks
+            report = {}
+        if report:
+            snap["congestion"] = report
+        return snap
+
+    # -- flight recorder seams (ISSUE 7) -----------------------------------
+
+    def _span_tree(self, req) -> "object":
+        from sdnmpi_tpu.control import events as ev
+
+        tree = (
+            self.flight.tree_for(req.span_id)
+            if self.flight is not None
+            else None
+        )
+        return ev.SpanTreeReply(tree)
+
+    def _flight_dump(self, req) -> "object":
+        from sdnmpi_tpu.control import events as ev
+
+        bundle = (
+            self.flight.freeze("manual", {})
+            if self.flight is not None
+            else {}
+        )
+        return ev.FlightDumpReply(bundle)
+
+    def _publish_anomaly(self, bundle: dict) -> None:
+        """Flight-recorder anomaly hook -> one EventAnomaly on the bus
+        (the RPC mirror broadcasts it). The summary strips the bulky
+        members — trees and full snapshots stay in the recorder and the
+        dump file."""
+        from sdnmpi_tpu.control import events as ev
+
+        summary = {
+            k: v
+            for k, v in bundle.items()
+            if k not in ("span_trees", "metrics", "events_tail", "exemplars")
+        }
+        self.bus.publish(ev.EventAnomaly(
+            bundle["trigger"], summary, bundle.get("path")
+        ))
+
+    def _topology_forensics(self) -> dict:
+        """Flight-bundle context: TopologyDB epoch/dirty-set state, the
+        utilization plane's epoch, and the latest congestion report —
+        the 'what did the graph look like' half of an incident."""
+        db = self.topology_manager.topologydb
+        plane = self.topology_manager.util_plane
+        out = {
+            "version": getattr(db, "version", None),
+            "n_switches": len(getattr(db, "switches", ())),
+            "util_epoch": plane.epoch if plane is not None else 0,
+            "congestion": self.topology_manager.congestion,
+        }
+        log = getattr(db, "_delta_log", None)
+        if log:
+            out["delta_log_tail"] = [list(e) for e in list(log)[-16:]]
+        return out

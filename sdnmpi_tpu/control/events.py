@@ -421,6 +421,68 @@ class TelemetryReply(Reply):
     telemetry: dict
 
 
+@dataclasses.dataclass
+class SpanTreeRequest(Request):
+    """Resolve one span id to the completed span tree containing it —
+    the pull half of exemplar resolution (ISSUE 7): a Prometheus
+    histogram bucket's exemplar span id comes back as the full request
+    trace from the flight recorder. Provided by the Controller;
+    ``tree`` is None when the id fell out of the bounded ring (or no
+    recorder is armed)."""
+
+    dst = "Controller"
+    span_id: int
+
+
+@dataclasses.dataclass
+class SpanTreeReply(Reply):
+    tree: Optional[dict]
+
+
+@dataclasses.dataclass
+class FlightDumpRequest(Request):
+    """Freeze a diagnostic bundle NOW (trigger="manual") — the pull-
+    mode twin of the anomaly triggers' automatic freeze. Provided by
+    the Controller; the bundle is {} when no recorder is armed."""
+
+    dst = "Controller"
+
+
+@dataclasses.dataclass
+class FlightDumpReply(Reply):
+    bundle: dict
+
+
+@dataclasses.dataclass
+class CongestionReportRequest(Request):
+    """The device-side congestion analytics of the latest Monitor pass
+    (ISSUE 7): top-k hot links, per-collective attribution (which
+    installed collectives ride them), and the discrete-vs-fractional
+    congestion figures. Provided by the TopologyManager; {} before the
+    first analytics pass (or without a utilization plane)."""
+
+    dst = "TopologyManager"
+
+
+@dataclasses.dataclass
+class CongestionReportReply(Reply):
+    report: dict
+
+
+@dataclasses.dataclass
+class EventAnomaly(Event):
+    """The flight recorder froze a diagnostic bundle: an anomaly
+    trigger fired (latency threshold, p99 regression, recovery
+    escalation, barrier timeout). ``summary`` is the bundle minus its
+    bulky members (span trees / snapshots stay in the recorder and the
+    dump file at ``path``); the RPC mirror broadcasts it as an
+    ``anomaly`` notification."""
+
+    trigger: str
+    summary: dict
+    path: Optional[str] = None
+
+
 # -- monitor --------------------------------------------------------------
 
 

@@ -115,6 +115,33 @@ _m_reval_drained = REGISTRY.counter(
     "entirely (the delta window's device-computed touched verdict): "
     "how much traffic a flap drains away from the failed region",
 )
+# delta-revalidation stage decomposition (ISSUE 7): the live twins of
+# bench config 8's repair/rescore/diff/install medians — the repair
+# stage is the oracle's own oracle_repair timing (utils/tracing.STATS);
+# the three control-plane stages record here per chunk, with matching
+# spans on BOTH the pipelined path and the serial / full-pass fallbacks
+# so traces stay comparable across escape hatches.
+_m_reval_rescore_s = REGISTRY.histogram(
+    "reval_rescore_seconds", LATENCY_BUCKETS_S,
+    "per-chunk re-scoring wall (delta dispatch -> window reaped)",
+)
+_m_reval_diff_s = REGISTRY.histogram(
+    "reval_diff_seconds", LATENCY_BUCKETS_S,
+    "per-chunk hop-diff wall (reaped window vs installed state)",
+)
+_m_reval_install_s = REGISTRY.histogram(
+    "reval_install_seconds", LATENCY_BUCKETS_S,
+    "per-chunk changed-span teardown + reinstall wall",
+)
+_m_reval_affected = REGISTRY.histogram(
+    "reval_affected_flows", SIZE_BUCKETS,
+    "flows re-scored per revalidation pass (the delta-narrowed blast "
+    "radius; full passes count everything installed)",
+)
+_m_recovery_redrive_s = REGISTRY.histogram(
+    "recovery_redrive_seconds", LATENCY_BUCKETS_S,
+    "wall of one recovery re-drive (retry-queue pop: deletes + resync)",
+)
 
 
 @dataclasses.dataclass
@@ -513,6 +540,7 @@ class Router:
         t_flush0 = time.perf_counter()
         stage_wall = 0.0  # dispatch + reap + install walls
         hidden_wall = 0.0  # in-flight device intervals the host overlapped
+        last_window_span = 0  # e2e exemplar: the burst's last window
 
         def _reap_timed(batch, handle, wsp, t_dispatched):
             """Reap window ``handle`` (timed, spanned) and finish its
@@ -563,6 +591,7 @@ class Router:
                     wsp = batch[0].span.child(
                         "route_window", n_pairs=len(batch)
                     )
+                    last_window_span = wsp.id or last_window_span
                     for p in batch:
                         p.park.end()
                         if p is not batch[0]:
@@ -603,7 +632,11 @@ class Router:
         finally:
             self._flushing = False
             e2e = time.perf_counter() - t_flush0
-            _m_e2e_s.observe(e2e)
+            # the flush's spans are all closed by now, so the ambient
+            # CURRENT_SPAN is gone — attribute the e2e sample to the
+            # burst's last window span explicitly (README's "explain
+            # this p99 spike" walkthrough starts from this exemplar)
+            _m_e2e_s.observe(e2e, exemplar=last_window_span)
             if e2e > 0:
                 # live twin of bench config 10's overlap_gain: the
                 # serial-equivalent wall (host stages + the in-flight
@@ -981,6 +1014,15 @@ class Router:
         touched = frozenset(
             int(d) for d in np.unique(hop_dpid[hop_dpid >= 0])
         )
+        # the directed-link index for congestion attribution (ISSUE 7):
+        # consecutive hop pairs of each routed block, vectorized over
+        # the same arrays — a hot link resolves to the collectives whose
+        # blocks actually traverse it, not to everything in the fabric
+        a, b = hop_dpid[:, :-1], hop_dpid[:, 1:]
+        ridden = (a >= 0) & (b >= 0)
+        links = frozenset(
+            zip(a[ridden].astype(int).tolist(), b[ridden].astype(int).tolist())
+        )
         self.collectives.add(
             CollectiveInstall(
                 cookie, coll_type, tuple(ranks), root_rank,
@@ -988,6 +1030,7 @@ class Router:
                 n_pairs=len(src_idx), n_flows=n_flows,
                 max_congestion=routes.max_congestion,
                 switches=touched,
+                links=links,
             )
         )
         self.bus.publish(
@@ -1092,24 +1135,30 @@ class Router:
             "reconciling datapath %#x: re-driving %d desired flows, "
             "%d lost teardowns", dpid, len(rows), len(lost),
         )
-        if lost:
-            verdict = self._send_deletes(dpid, lost)
-            self.recovery.note_send(
-                verdict, delete_rows={dpid: set(lost)}
-            )
-        if not rows:
-            return
-        # the down-edge cleared this switch's FDB rows; restore the
-        # bookkeeping the installs below re-create on the switch
-        for src, dst, spec in rows:
-            if not self.fdb.exists(dpid, src, dst):
-                self.fdb.update(dpid, src, dst, spec.out_port)
-                self.bus.publish(
-                    ev.EventFDBUpdate(dpid, src, dst, spec.out_port)
+        sp = start_span(
+            "reconcile", dpid=dpid, n_flows=len(rows), n_lost=len(lost)
+        )
+        try:
+            if lost:
+                verdict = self._send_deletes(dpid, lost)
+                self.recovery.note_send(
+                    verdict, delete_rows={dpid: set(lost)}
                 )
-        self.recovery.note_reconcile(len(rows))
-        verdict = self._send_desired(dpid, rows)
-        self.recovery.note_send(verdict)
+            if not rows:
+                return
+            # the down-edge cleared this switch's FDB rows; restore the
+            # bookkeeping the installs below re-create on the switch
+            for src, dst, spec in rows:
+                if not self.fdb.exists(dpid, src, dst):
+                    self.fdb.update(dpid, src, dst, spec.out_port)
+                    self.bus.publish(
+                        ev.EventFDBUpdate(dpid, src, dst, spec.out_port)
+                    )
+            self.recovery.note_reconcile(len(rows))
+            verdict = self._send_desired(dpid, rows)
+            self.recovery.note_send(verdict)
+        finally:
+            sp.end()
 
     def _send_deletes(self, dpid: int, rows) -> "InstallVerdict | None":
         """Tear down ``rows`` (``[(src, dst), ...]``) on one switch —
@@ -1208,6 +1257,14 @@ class Router:
                 self.recovery.stash_lost_deletes(dpid, retry.deletes)
                 continue
             self.recovery.note_retry()
+            # the retry re-drive is a root span of its own (no request
+            # tree to hang from): flight-recorder bundles show WHICH
+            # switch was being re-driven when an anomaly froze
+            sp = start_span(
+                "recovery_retry", dpid=dpid, resync=retry.resync,
+                n_deletes=len(retry.deletes),
+            )
+            t0 = time.perf_counter()
             ok = True
             deletes = [
                 (s, d) for (s, d) in sorted(retry.deletes)
@@ -1216,29 +1273,35 @@ class Router:
                 # deleting it now would wipe the fresh flow
                 if not self.recovery.desired.has(dpid, s, d)
             ]
-            if deletes:
-                verdict = self._send_deletes(dpid, deletes)
-                if verdict is not None:
-                    self.recovery.note_send(
-                        verdict, delete_rows={dpid: set(deletes)},
-                        reschedule=False,
-                    )
-                    ok = ok and dpid not in verdict.dropped
-            if retry.resync:
-                rows = self.recovery.desired.entries_for(dpid)
-                if rows:
-                    self.recovery.note_reconcile(len(rows))
-                    verdict = self._send_desired(dpid, rows)
+            try:
+                if deletes:
+                    verdict = self._send_deletes(dpid, deletes)
                     if verdict is not None:
-                        self.recovery.note_send(verdict, reschedule=False)
+                        self.recovery.note_send(
+                            verdict, delete_rows={dpid: set(deletes)},
+                            reschedule=False,
+                        )
                         ok = ok and dpid not in verdict.dropped
-            if ok:
-                self.recovery.succeed(dpid)
-            elif not self.recovery.schedule(
-                now=now, dpid=dpid, deletes=set(deletes),
-                resync=retry.resync,
-            ):
-                self._resync_datapath(dpid)
+                if retry.resync:
+                    rows = self.recovery.desired.entries_for(dpid)
+                    if rows:
+                        self.recovery.note_reconcile(len(rows))
+                        verdict = self._send_desired(dpid, rows)
+                        if verdict is not None:
+                            self.recovery.note_send(
+                                verdict, reschedule=False
+                            )
+                            ok = ok and dpid not in verdict.dropped
+                if ok:
+                    self.recovery.succeed(dpid)
+                elif not self.recovery.schedule(
+                    now=now, dpid=dpid, deletes=set(deletes),
+                    resync=retry.resync,
+                ):
+                    self._resync_datapath(dpid)
+            finally:
+                sp.end(ok=ok)
+                _m_recovery_redrive_s.observe(time.perf_counter() - t0)
 
     def _resync_datapath(self, dpid: int) -> None:
         """Last-resort escalation after retry exhaustion: wipe the
@@ -1255,11 +1318,17 @@ class Router:
         log.warning(
             "datapath %#x: retries exhausted; wiping and resyncing", dpid
         )
-        self.southbound.flow_mod(dpid, of.FlowMod(
-            match=of.Match(), actions=(), priority=0,
-            command=of.OFPFC_DELETE,
-        ))
-        self.bus.publish(ev.EventDatapathUp(dpid))
+        # the escalation span: the chaos-soak acceptance asserts a
+        # frozen bundle's span trees contain this stage (ISSUE 7)
+        sp = start_span("recovery_resync", dpid=dpid)
+        try:
+            self.southbound.flow_mod(dpid, of.FlowMod(
+                match=of.Match(), actions=(), priority=0,
+                command=of.OFPFC_DELETE,
+            ))
+            self.bus.publish(ev.EventDatapathUp(dpid))
+        finally:
+            sp.end()
 
     def _effective_dst(self, dst: str) -> str | None:
         """The MAC a flow actually targets: for MPI flows the dst is a
@@ -1376,6 +1445,22 @@ class Router:
             _m_revalidations_skipped.inc()
             return  # nothing advanced since the last pass
         _m_revalidations.inc()
+        # one span tree per revalidation pass (ISSUE 7): root `reval`
+        # with per-chunk reval_rescore/reval_diff/reval_install stages —
+        # emitted identically by the pipelined path, the serial
+        # (pipelined_install=False) fallback, and the link-add full
+        # pass, so traces stay comparable across escape hatches
+        rsp = start_span(
+            "reval",
+            narrowed=dirty is not None,
+            n_dirty=0 if dirty is None else len(dirty),
+        )
+        try:
+            self._revalidate_flows_spanned(dirty, rsp)
+        finally:
+            rsp.end()
+
+    def _revalidate_flows_spanned(self, dirty, rsp) -> None:
         for install in self.collectives:
             if (
                 dirty is not None
@@ -1413,7 +1498,9 @@ class Router:
 
         from sdnmpi_tpu.oracle.batch import WindowRoutes
 
-        def process(chunk, wr) -> None:
+        _m_reval_affected.observe(len(resolved))
+
+        def process(chunk, wr, csp=NULL_SPAN) -> None:
             """Diff + re-drive one reaped window: per-pair hop diffs
             pick the changed spans; the span teardown flushes as ONE
             batched OFPFC_DELETE window BEFORE the reinstall window (a
@@ -1422,26 +1509,49 @@ class Router:
             fresh entry too), and the reinstall ships through the same
             vectorized window installer the packet-in path uses — the
             FDB dedup inside it keeps surviving hops untouched, so only
-            changed spans reach the wire."""
+            changed spans reach the wire. ``csp`` is the chunk's span;
+            the diff and install stages record as its children plus the
+            reval_diff/install_seconds histograms."""
             chunk_doomed: list[tuple[int, str, str]] = []
             entries: list[tuple[str, str, str | None]] = []
-            for k, ((src, dst), effective) in enumerate(chunk):
-                installed = flows[(src, dst)]
-                n = int(wr.hop_len[k])
-                new_hops = {
-                    int(wr.hop_dpid[k, h]): int(wr.hop_port[k, h])
-                    for h in range(n)
-                }
-                for dpid, port in installed.items():
-                    if new_hops.get(dpid) != port:
-                        self.fdb.remove(dpid, src, dst)
-                        chunk_doomed.append((dpid, src, dst))
-                entries.append((
-                    src, dst, effective if is_sdn_mpi_addr(dst) else None
-                ))
-            self._publish_fdb_removes(chunk_doomed)
-            self._del_flows_window(chunk_doomed)
-            self._install_window(entries, wr)
+            try:
+                t0 = time.perf_counter()
+                dsp = csp.child("reval_diff", n_pairs=len(chunk))
+                try:
+                    for k, ((src, dst), effective) in enumerate(chunk):
+                        installed = flows[(src, dst)]
+                        n = int(wr.hop_len[k])
+                        new_hops = {
+                            int(wr.hop_dpid[k, h]): int(wr.hop_port[k, h])
+                            for h in range(n)
+                        }
+                        for dpid, port in installed.items():
+                            if new_hops.get(dpid) != port:
+                                self.fdb.remove(dpid, src, dst)
+                                chunk_doomed.append((dpid, src, dst))
+                        entries.append((
+                            src, dst,
+                            effective if is_sdn_mpi_addr(dst) else None,
+                        ))
+                finally:
+                    dsp.end(n_changed=len(chunk_doomed))
+                    _m_reval_diff_s.observe(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                isp = csp.child(
+                    "reval_install", n_changed=len(chunk_doomed)
+                )
+                try:
+                    self._publish_fdb_removes(chunk_doomed)
+                    self._del_flows_window(chunk_doomed)
+                    self._install_window(entries, wr, parent=isp)
+                finally:
+                    isp.end()
+                    _m_reval_install_s.observe(time.perf_counter() - t0)
+            finally:
+                # a raising stage must not leak the chunk span open —
+                # the anomaly bundle frozen FOR that failure needs the
+                # (partial) revalidation tree completed, not buffered
+                csp.end()
             if wr.touched is not None:
                 # device-computed attribution: flows whose new path left
                 # the dirty region entirely (they drained off the flap)
@@ -1449,35 +1559,58 @@ class Router:
                     int(np.count_nonzero(~wr.touched & (wr.hop_len > 0)))
                 )
 
+        def reap_prev(prev) -> None:
+            chunk, window, csp, resc, t_re = prev
+            try:
+                wr = window.reap()
+            except BaseException:
+                # raising reap: close the rescore + chunk spans (same
+                # hardening the flush loop's PR-4 round-2 fix applied)
+                resc.end()
+                _m_reval_rescore_s.observe(time.perf_counter() - t_re)
+                csp.end()
+                raise
+            resc.end()
+            _m_reval_rescore_s.observe(time.perf_counter() - t_re)
+            process(chunk, wr, csp)
+
         # pipelined re-scoring: windows of coalesce_max_batch pairs
         # double-buffer through the delta dispatch API — window k+1
         # computes on device while window k diffs and installs
         step = max(1, self.config.coalesce_max_batch)
-        prev: tuple | None = None  # (chunk, window)
+        prev: tuple | None = None  # (chunk, window, csp, rescore span, t0)
         for lo in range(0, len(resolved) + 1, step):
             chunk = resolved[lo : lo + step]
             window = None
+            csp = resc = NULL_SPAN
+            t_re = 0.0
             if chunk:
                 pairs = [(src, eff) for (src, _), eff in chunk]
+                csp = rsp.child("reval_window", n_pairs=len(chunk))
+                resc = csp.child("reval_rescore")
+                t_re = time.perf_counter()
                 window = self._dispatch_window(pairs, dirty=dirty)
                 if window is None:
                     # serial fallback (pipelining off / minimal stacks):
-                    # blocking batch request, same diff + install legs
+                    # blocking batch request, same stage spans and
+                    # histograms as the pipelined leg
                     if prev is not None:
-                        process(prev[0], prev[1].reap())
+                        reap_prev(prev)
                         prev = None
                     reply = self.bus.request(
                         ev.FindRoutesBatchRequest(pairs)
                     )
-                    process(chunk, WindowRoutes.from_fdbs(reply.fdbs))
+                    resc.end()
+                    _m_reval_rescore_s.observe(time.perf_counter() - t_re)
+                    process(chunk, WindowRoutes.from_fdbs(reply.fdbs), csp)
                     continue
             if prev is not None:
-                process(prev[0], prev[1].reap())
-            prev = (chunk, window) if chunk else None
+                reap_prev(prev)
+            prev = (chunk, window, csp, resc, t_re) if chunk else None
         if prev is not None:  # last partial chunk (len % step != 0):
             # the trailing empty range slot that would have flushed it
             # only exists when len(resolved) is a step multiple
-            process(prev[0], prev[1].reap())
+            reap_prev(prev)
 
     def _reinstall_collective(self, install: CollectiveInstall) -> None:
         """Re-route a previously installed collective against the current
@@ -1557,6 +1690,21 @@ class Router:
                 self._add_flows_for_path(fdb, src, dst, true_dst)
 
     # -- snapshots --------------------------------------------------------
+
+    def window_census(self) -> dict:
+        """What is mid-air in the install pipeline right now — the
+        flight recorder folds this into every frozen bundle (ISSUE 7)
+        so an anomaly shows its in-flight context, not just its
+        counters."""
+        return {
+            "pending_routes": len(self._pending),
+            "flushing": self._flushing,
+            "inflight_windows": _m_inflight.value,
+            "pending_barriers": len(self.recovery._pending),
+            "retry_queue": sorted(self.recovery._retries),
+            "desired_flows": self.recovery.desired.total(),
+            "collectives": len(self.collectives),
+        }
 
     def _current_fdb(self, req: ev.CurrentFDBRequest) -> ev.CurrentFDBReply:
         return ev.CurrentFDBReply(self.fdb)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from collections import deque
 
 import numpy as np
@@ -84,6 +85,13 @@ _m_window_bytes = REGISTRY.histogram(
 _m_slices = REGISTRY.counter(
     "southbound_install_slices_total",
     "install_highwater byte slices written by batched installs",
+)
+_m_slice_wait = REGISTRY.histogram(
+    "southbound_slice_wait_seconds",
+    help="per-switch wait in the round-robin install scheduler between a "
+    "slice being queued behind other switches' slices and its write "
+    "(ISSUE 7: how long a switch's span sat parked while the window's "
+    "other spans took their turns)",
 )
 _m_echo_timeouts = REGISTRY.counter(
     "echo_timeouts_total",
@@ -511,9 +519,16 @@ class OFSouthbound:
         sent_off = [0] * len(spans)
         #: group index -> ("sent" | "dropped", barrier xid | None)
         outcome: dict[int, tuple] = {}
-        ready = deque(range(len(spans)))
+        t_win = time.monotonic()
+        ready = deque((i, t_win) for i in range(len(spans)))
         while ready:
-            i = ready.popleft()
+            i, t_parked = ready.popleft()
+            # per-switch slice wait (ISSUE 7): how long this switch's
+            # next slice sat parked while other switches' slices took
+            # their round-robin turns — the scheduler's fairness signal
+            # (a stalled or enormous peer shows up HERE, not as other
+            # switches' install latency)
+            _m_slice_wait.observe(time.monotonic() - t_parked)
             dpid, span = spans[i]
             off = sent_off[i]
             if off < len(span):
@@ -525,7 +540,8 @@ class OFSouthbound:
                 _m_slices.inc()
                 sent_off[i] = off + step
                 if sent_off[i] < len(span):
-                    ready.append(i)  # back of the round-robin queue
+                    # back of the round-robin queue
+                    ready.append((i, time.monotonic()))
                     continue
             # span fully queued: terminate it with the barrier NOW so
             # the receipt follows the last slice on this peer's stream

@@ -26,8 +26,24 @@ from sdnmpi_tpu.control.bus import EventBus
 from sdnmpi_tpu.core.topology_db import TopologyDB
 from sdnmpi_tpu.protocol import openflow as of
 from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
+from sdnmpi_tpu.utils.metrics import REGISTRY
 
 log = logging.getLogger("TopologyManager")
+
+# device-side congestion analytics (ISSUE 7): one jitted top-k pass per
+# EventStatsFlush over the published utilization plane, decoded to the
+# report served by CongestionReportRequest; these gauges are the
+# scrape-able headline figures
+_m_hot_bps = REGISTRY.gauge(
+    "congestion_hot_link_bps",
+    "measured bps of the fabric's hottest directed link (device top-k "
+    "pass per Monitor flush)",
+)
+_m_hot_collectives = REGISTRY.gauge(
+    "congestion_hot_collectives",
+    "installed collectives whose routed blocks ride a current top-k hot "
+    "link",
+)
 
 
 class TopologyManager:
@@ -97,6 +113,12 @@ class TopologyManager:
         bus.provide(ev.UtilEpochRequest, self._util_epoch)
         bus.provide(ev.FindCollectiveRoutesRequest, self._find_routes_collective)
         bus.provide(ev.BroadcastRequest, self._broadcast_request)
+        bus.provide(ev.CongestionReportRequest, self._congestion_report)
+
+        #: latest device-side congestion analytics (ISSUE 7): refreshed
+        #: per EventStatsFlush once the utilization plane is bound;
+        #: served over the bus / mirrored into the telemetry snapshot
+        self.congestion: dict = {}
 
     # -- bootstrap flows (reference: sdnmpi/topology.py:94-108) -----------
 
@@ -377,12 +399,73 @@ class TopologyManager:
 
     def _stats_flush(self, event: ev.EventStatsFlush) -> None:
         """Monitor end-of-pass edge: one vectorized scatter of the
-        pass's staged samples into the device plane. Before the plane
-        is bound (no routing call has built tensors yet) samples simply
-        stay staged — the first base-cost evaluation flushes them."""
+        pass's staged samples into the device plane, then one jitted
+        congestion-analytics pass over the published epoch. Before the
+        plane is bound (no routing call has built tensors yet) samples
+        simply stay staged — the first base-cost evaluation flushes
+        them."""
         p = self.util_plane
         if p is not None and p.sync(self.topologydb):
             p.flush()
+            self._refresh_congestion()
+
+    def _congestion_report(
+        self, req: ev.CongestionReportRequest
+    ) -> ev.CongestionReportReply:
+        return ev.CongestionReportReply(self.congestion)
+
+    def _refresh_congestion(self) -> None:
+        """Device-side congestion analytics (ISSUE 7), one pass per
+        flush: top-k hot links (jitted top-k over the published [V*V]
+        snapshot — fixed shape, zero recompiles across churn), the
+        per-collective attribution (which installed collectives' blocks
+        ride those links, via the install-time directed-link index),
+        and the oracle's discrete-vs-fractional congestion figures."""
+        p = self.util_plane
+        if p is None or not p.bound:
+            return
+        hot = p.hot_links(self.config.congestion_topk)
+        _m_hot_bps.set(hot[0]["bps"] if hot else 0.0)
+        colls: list[dict] = []
+        if hot:
+            try:
+                table = self.bus.request(
+                    ev.CurrentCollectivesRequest()
+                ).collectives
+            except LookupError:
+                table = ()  # minimal stacks without a Router
+            hot_keys = {(h["src"], h["dst"]): h["bps"] for h in hot}
+            for install in table:
+                if not install.links:
+                    continue
+                ride = [k for k in hot_keys if k in install.links]
+                if ride:
+                    colls.append({
+                        "cookie": install.cookie,
+                        "coll_type": install.coll_type,
+                        "n_pairs": install.n_pairs,
+                        "hot_links": len(ride),
+                        "bps": sum(hot_keys[k] for k in ride),
+                    })
+            colls.sort(key=lambda c: -c["bps"])
+        _m_hot_collectives.set(len(colls))
+        oracle = getattr(self.topologydb, "_oracle", None)
+        self.congestion = {
+            "epoch": p.epoch,
+            "top": hot,
+            "collectives": colls,
+            "discrete_max": getattr(
+                oracle, "last_discrete_congestion", 0.0
+            ),
+            "fractional_max": getattr(
+                oracle, "last_fractional_congestion", 0.0
+            ),
+            # the oracle only records a ratio when both figures came
+            # from the SAME DAG-balanced batch — recomputing it here
+            # would pair a later shortest/greedy pass's discrete figure
+            # with a stale fractional bound
+            "ratio": getattr(oracle, "last_congestion_ratio", 0.0),
+        }
 
     def _port_stats(self, event: ev.EventPortStats) -> None:
         key = (event.dpid, event.port_no)

@@ -37,6 +37,11 @@ class CollectiveInstall:
     #: flap re-routes a collective only when a dirtied switch is in
     #: here. Empty = unknown (pre-index installs) -> always re-route.
     switches: frozenset = frozenset()
+    #: directed (src_dpid, dst_dpid) links the routed blocks ride —
+    #: the congestion-analytics attribution index (ISSUE 7): a hot
+    #: link's load is attributed to exactly the collectives whose
+    #: installed blocks traverse it. Empty = unknown.
+    links: frozenset = frozenset()
 
     @property
     def signature(self) -> tuple:

@@ -131,6 +131,13 @@ def config_from_args(args) -> Config:
         install_retry_backoff_s=getattr(args, "install_retry_backoff", 0.25),
         echo_interval_s=getattr(args, "echo_interval", 15.0),
         echo_timeout_s=getattr(args, "echo_timeout", 45.0),
+        trace_dump=getattr(args, "trace_dump", None) or "",
+        flight_recorder=not getattr(args, "no_flight_recorder", False),
+        flight_dump_dir=getattr(args, "flight_dump", None) or "",
+        flight_latency_threshold_s=getattr(
+            args, "anomaly_latency_threshold", 0.0
+        ),
+        flight_p99_factor=getattr(args, "anomaly_p99_factor", 0.0),
     )
 
 
@@ -141,6 +148,15 @@ async def amain(args) -> None:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
 
         set_trace_sink(config.trace_log)
+    trace_collector = None
+    if config.trace_dump:
+        # in-memory span collector tee'd beside any file sink; rendered
+        # as a Perfetto/chrome://tracing timeline on shutdown
+        from sdnmpi_tpu.api.traceview import TraceCollector
+        from sdnmpi_tpu.utils.tracing import add_trace_sink
+
+        trace_collector = TraceCollector()
+        add_trace_sink(trace_collector)
     if listen:
         # real-switch mode: the southbound is an OpenFlow 1.0 TCP server
         # (control/southbound.py) and the topology is whatever dials in —
@@ -256,6 +272,21 @@ async def amain(args) -> None:
             dump(metrics_dump, snapshot=controller.telemetry())
             if metrics_dump != "-":
                 log.info("metrics exposition written to %s", metrics_dump)
+        if trace_collector is not None:
+            trace = trace_collector.dump(config.trace_dump)
+            log.info(
+                "Perfetto trace (%d events) written to %s",
+                len(trace["traceEvents"]), config.trace_dump,
+            )
+        if controller.flight is not None:
+            if controller.flight.bundles:
+                log.info(
+                    "flight recorder froze %d diagnostic bundle(s); "
+                    "last trigger: %s",
+                    len(controller.flight.bundles),
+                    controller.flight.bundles[-1]["trigger"],
+                )
+            controller.flight.disarm()
         if args.checkpoint:
             from sdnmpi_tpu.api.snapshot import save_checkpoint
 
@@ -378,6 +409,33 @@ def build_parser() -> argparse.ArgumentParser:
         "counters converge it back",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
+    parser.add_argument(
+        "--trace-dump", metavar="PATH",
+        help="write the run's span trees as a Perfetto/chrome://tracing "
+        "JSON timeline on shutdown (api/traceview.py)",
+    )
+    parser.add_argument(
+        "--no-flight-recorder", action="store_true",
+        help="disable the in-memory flight recorder (span-tree ring, "
+        "anomaly triggers, histogram exemplars)",
+    )
+    parser.add_argument(
+        "--flight-dump", metavar="DIR",
+        help="write each anomaly trigger's diagnostic bundle as a JSON "
+        "file under DIR (default: bundles stay in memory, readable via "
+        "the flight_dump RPC method)",
+    )
+    parser.add_argument(
+        "--anomaly-latency-threshold", type=float, default=0.0,
+        metavar="SECONDS",
+        help="freeze a diagnostic bundle when a route/install/re-route "
+        "latency observation provably exceeds this bound (0 = off)",
+    )
+    parser.add_argument(
+        "--anomaly-p99-factor", type=float, default=0.0, metavar="FACTOR",
+        help="freeze a bundle when an interval's estimated p99 exceeds "
+        "FACTOR x the rolling baseline (0 = off)",
+    )
     parser.add_argument(
         "--event-log",
         help="JSONL control-plane event log (every bus event, one line)",

@@ -32,6 +32,28 @@ _m_repairs = REGISTRY.counter(
 _m_full_refreshes = REGISTRY.counter(
     "oracle_full_refreshes_total", "full tensorize + APSP recomputes"
 )
+# congestion analytics (ISSUE 7): the discrete max link load of the
+# routes actually installed vs the DAG balancer's fractional bound for
+# the same batch — their ratio is the sampling/scheduling gap the
+# phase-scheduling roadmap item (arxiv 2309.13541) exists to close
+# (currently 8,036 discrete vs the 5,544 fractional bound at the
+# flagship shape). Updated per reaped balanced/collective pass;
+# mirrored over RPC through the one-registry telemetry snapshot.
+_m_disc_congestion = REGISTRY.gauge(
+    "congestion_discrete_max",
+    "max discrete link load (flows per link) of the last balanced pass's "
+    "installed paths",
+)
+_m_frac_congestion = REGISTRY.gauge(
+    "congestion_fractional_max",
+    "the DAG balancer's fractional max-link-load bound of the last "
+    "balanced pass (the relaxation the discrete sampler rounds)",
+)
+_m_congestion_ratio = REGISTRY.gauge(
+    "congestion_discrete_over_fractional",
+    "discrete / fractional max-congestion of the last DAG-balanced pass "
+    "(1.0 = sampling achieved the bound; the gap is scheduling headroom)",
+)
 
 
 @jax.jit
@@ -329,6 +351,15 @@ class RouteOracle:
         #: assert the churn path actually stays incremental)
         self.repair_count: int = 0
         self.full_refresh_count: int = 0
+        #: congestion analytics (ISSUE 7): the last DAG-balanced pass's
+        #: fractional max-link bound and the last reaped pass's discrete
+        #: figure — the registry gauges' instance-level twins.
+        #: ``last_congestion_ratio`` is only written when both figures
+        #: came from the SAME DAG-balanced batch (cross-batch ratios are
+        #: meaningless — see _note_congestion).
+        self.last_fractional_congestion: float = 0.0
+        self.last_discrete_congestion: float = 0.0
+        self.last_congestion_ratio: float = 0.0
 
     #: max link-level deltas the incremental repair path absorbs before
     #: falling back to the full recompute (oracle/incremental.py); the
@@ -1132,6 +1163,8 @@ class RouteOracle:
             _start_host_copy(slots_d)
 
             def reap_sharded() -> np.ndarray:
+                self.last_fractional_congestion = float(np.asarray(_maxc))
+                _m_frac_congestion.set(self.last_fractional_congestion)
                 slots = np.asarray(slots_d)[: len(src_idx)]
                 return self._decode(slots, src_idx, dst_idx)
 
@@ -1172,7 +1205,13 @@ class RouteOracle:
         _start_host_copy(buf)
 
         def reap() -> np.ndarray:
-            slots, _ = unpack_result(np.asarray(buf), len(src_p), max_len)
+            slots, frac = unpack_result(np.asarray(buf), len(src_p), max_len)
+            # the packed tail carries the balancer's FRACTIONAL max-link
+            # bound (oracle/dag.balance_rounds) — keep it beside the
+            # discrete figure the caller computes from the sampled paths
+            # so the congestion-analytics gauges can report the gap
+            self.last_fractional_congestion = float(frac)
+            _m_frac_congestion.set(self.last_fractional_congestion)
             return self._decode(slots[: len(src_idx)], src_idx, dst_idx)
 
         return reap
@@ -1184,6 +1223,20 @@ class RouteOracle:
         return native.decode_slots(
             slots, self._order, src_idx, dst_idx, complete=True
         )
+
+    def _note_congestion(self, discrete: float, dag: bool) -> None:
+        """Record a just-reaped balanced pass's discrete max-congestion
+        beside the DAG balancer's fractional bound and publish the
+        ratio gauge (only when the DAG engine balanced THIS batch —
+        the greedy scanner and shortest/adaptive paths have no
+        fractional relaxation to compare against)."""
+        self.last_discrete_congestion = float(discrete)
+        _m_disc_congestion.set(self.last_discrete_congestion)
+        if dag and discrete > 0 and self.last_fractional_congestion > 0:
+            self.last_congestion_ratio = (
+                discrete / self.last_fractional_congestion
+            )
+            _m_congestion_ratio.set(self.last_congestion_ratio)
 
     def _pad_flows(self, src_idx, dst_idx, weight=None):
         """End-pad a flow batch to the mesh shard count: -1 endpoints
@@ -1390,11 +1443,14 @@ class RouteOracle:
                 return np.asarray(nodes_d)
 
         n_pairs = len(pairs)
+        used_dag = len(src_idx) >= threshold
 
         def reap() -> WindowRoutes:
-            return self._materialize_window(
+            wr = self._materialize_window(
                 t, groups, group_subs, paths_reap(), n_pairs, results
             )
+            self._note_congestion(wr.max_congestion, dag=used_dag)
+            return wr
 
         return RouteWindow(reap)
 
@@ -1702,6 +1758,9 @@ class RouteOracle:
             counts_sub[ln == 0] = 0.0
             routes.max_congestion = float(
                 link_loads(paths, counts_sub, t.v).max(initial=0.0)
+            )
+            self._note_congestion(
+                routes.max_congestion, dag=policy == "balanced"
             )
             if inter_h is not None:
                 routes.n_detours = int(counts_sub[inter_h >= 0].sum())

@@ -52,6 +52,7 @@ flushes pay a dict lookup, not a [V, V] rebuild + transfer.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -130,6 +131,18 @@ def _carry_slots(old_live, old_idx, new_idx, zeros):
     return zeros.at[new_idx].set(vals, mode="drop")
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_hot(live, k):
+    """Top-k hottest directed links of the published snapshot: one
+    device reduction over the flat [V*V] buffer returning (values,
+    flat indices) — the whole congestion-analytics read in ONE jitted
+    pass (the max IS vals[0]). ``k`` is static and the buffer shape
+    changes only with topology capacity, so a churn storm compiles
+    this exactly once (the ISSUE-7 zero-recompile probe)."""
+    count_trace("utilplane_topk")
+    return jax.lax.top_k(live, k)
+
+
 @jax.jit
 def _scale_base(live, cap, alpha, share):
     """Normalized base-cost matrix from the flat snapshot: the same
@@ -189,6 +202,8 @@ class UtilPlane:
         self._flat_to_key: dict[int, tuple[int, int]] = {}
         #: dpid -> tensor row (copy of TopoTensors.index at bind)
         self._dpid_row: dict[int, int] = {}
+        #: tensor row -> dpid (hot-link analytics decode)
+        self._row_dpid: dict[int, int] = {}
         self._v = 0
         self._live = None  # [V*V] f32 device buffer samples land in
         self._snap = None  # published epoch buffer routing reads
@@ -433,6 +448,7 @@ class UtilPlane:
         self._key_to_flat = new_map
         self._flat_to_key = {f: k for k, f in new_map.items()}
         self._dpid_row = dict(tensors.index)
+        self._row_dpid = {r: d for d, r in self._dpid_row.items()}
         self._v = v
         self._version = version
         self.rebuild_count += 1
@@ -450,6 +466,33 @@ class UtilPlane:
     def snapshot(self) -> jax.Array:
         """[V, V] device view of the published epoch's raw bps state."""
         return self._snap.reshape(self._v, self._v)
+
+    def hot_links(self, k: int = 8) -> list[dict]:
+        """Top-k hottest directed links of the published epoch, decoded
+        to ``[{"src", "dst", "port", "bps"}, ...]`` (descending, zero-
+        load entries dropped). The reduction is one jitted device pass
+        (:func:`_topk_hot`, fixed [V*V] shape, static k — zero
+        recompiles across topology churn); only the k winners' scalars
+        cross the host link. ``port`` is -1 when the slot has no mapped
+        link key (a just-removed cable whose sample was cleared)."""
+        if self._snap is None:
+            return []
+        k = max(1, min(int(k), self._v * self._v))
+        vals, idx = _topk_hot(self._snap, k)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        out: list[dict] = []
+        for bps, flat in zip(vals.tolist(), idx.tolist()):
+            if bps <= 0.0:
+                break  # top_k is sorted: the rest are idle slots
+            key = self._flat_to_key.get(int(flat))
+            out.append({
+                "src": self._row_dpid.get(int(flat) // self._v, -1),
+                "dst": self._row_dpid.get(int(flat) % self._v, -1),
+                "port": -1 if key is None else int(key[1]),
+                "bps": float(bps),
+            })
+        return out
 
     def base(self, alpha: float, cap: float, share: float) -> jax.Array:
         """Normalized [V, V] base-cost tensor of the published epoch,

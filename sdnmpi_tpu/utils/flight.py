@@ -1,0 +1,593 @@
+"""Fabric flight recorder: bounded in-memory diagnostics + anomaly
+triggers (ISSUE 7).
+
+The PR-4 telemetry plane answers "how is the fabric doing *now*"; this
+module answers "what just happened" after the fact — the one-in-10k
+slow flap that cannot be reproduced. Three bounded in-memory windows:
+
+- the last N **completed span trees** (assembled live from the trace
+  stream the recorder tees into via ``tracing.add_trace_sink``);
+- a rolling window of **registry snapshots** (one per Monitor pass —
+  the metrics-delta baseline every trigger compares against);
+- a tail of recent **bus events** (type names + timestamps, the causal
+  context of whatever fired).
+
+**Anomaly triggers** are predicates over consecutive snapshot deltas:
+:class:`HistogramThreshold` (a fresh observation landed at/above a
+latency bound), :class:`P99Regression` (the last interval's estimated
+p99 regressed past a factor of the rolling window's), and
+:class:`CounterSpike` (recovery escalations, barrier timeouts — any
+monotonic counter that moved). When one fires, the recorder **freezes a
+diagnostic bundle** — span trees, metrics delta, context provider
+output (TopologyDB dirty-set/epoch state, in-flight window census),
+the event tail, and every armed histogram's exemplar span ids — keeps
+it in a bounded ring, optionally writes it to a JSON dump file, and
+calls ``on_anomaly`` (the Controller publishes it as ``EventAnomaly``,
+which the RPC mirror broadcasts as an ``anomaly`` notification).
+
+**Exemplar resolution**: arming the recorder arms per-bucket exemplars
+on every registry histogram (utils/metrics.Histogram), so a Prometheus
+spike's bucket carries the span id of its latest observation and
+:meth:`FlightRecorder.tree_for` resolves that id to the full request
+tree — spike -> concrete trace, no reproduction needed.
+
+Everything is deque-bounded; steady-state ingest is one dict/deque
+append per trace record and one append per bus event. With the
+recorder disarmed nothing here runs at all (the tracing layer's
+no-sink fast path is untouched).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Callable, Optional
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+_m_trees = REGISTRY.gauge(
+    "flight_recorded_trees", "completed span trees held by the recorder"
+)
+_m_anomalies = REGISTRY.labeled_counter(
+    "flight_anomalies_total", "trigger", "anomaly triggers fired"
+)
+_m_dumps = REGISTRY.counter(
+    "flight_dumps_total", "diagnostic bundles written to dump files"
+)
+
+#: the most recently armed recorder — the seam the bench env hook
+#: (:func:`install_env_dump_hook`) and pull-mode RPC reach it through
+RECORDER: Optional["FlightRecorder"] = None
+
+#: env var the bench runner sets for config subprocesses: a path to
+#: dump the recorder's frozen bundles to at interpreter exit
+DUMP_ENV = "SDNMPI_FLIGHT_DUMP"
+
+
+def _estimate_p99(buckets, counts) -> float:
+    """Nearest-rank p99 estimate from per-bucket counts: the upper edge
+    of the bucket holding the 99th-percentile rank (+Inf bucket reports
+    the last finite edge — a lower bound, which is the conservative
+    side for a regression trigger)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = max(1, -(-99 * total // 100))  # ceil(0.99 n), 1-based
+    run = 0
+    for i, c in enumerate(counts):
+        run += c
+        if run >= rank:
+            return float(buckets[i]) if i < len(buckets) else float(
+                buckets[-1]
+            )
+    return float(buckets[-1])
+
+
+def _hist_delta(cur: dict, prev: Optional[dict]) -> tuple[list, int]:
+    """(per-bucket count delta, total delta) of one histogram between
+    two snapshots (prev None = everything is new)."""
+    counts = list(cur["counts"])
+    if prev is not None and len(prev["counts"]) == len(counts):
+        counts = [a - b for a, b in zip(counts, prev["counts"])]
+    return counts, sum(counts)
+
+
+@dataclasses.dataclass
+class HistogramThreshold:
+    """Fire when a fresh observation of ``histogram`` landed in a
+    bucket whose LOWER edge is at or above ``threshold_s`` — i.e. the
+    value was provably >= the threshold (the straddling bucket is
+    deliberately not counted: a histogram cannot distinguish its
+    members, and a false anomaly is worse than a late one). A threshold
+    beyond the last finite bucket edge clamps to that edge — the
+    histogram cannot distinguish past it, and a silently-dead trigger
+    is worse than a slightly eager one."""
+
+    histogram: str
+    threshold_s: float
+
+    @property
+    def name(self) -> str:
+        return f"latency:{self.histogram}>={self.threshold_s}"
+
+    def check(self, prev: dict, cur: dict, window=None) -> Optional[dict]:
+        h1 = cur.get("histograms", {}).get(self.histogram)
+        if h1 is None:
+            return None
+        h0 = prev.get("histograms", {}).get(self.histogram)
+        delta, _total = _hist_delta(h1, h0)
+        bounds = h1["buckets"]
+        threshold = min(self.threshold_s, float(bounds[-1]))
+        # bucket i's lower edge is bounds[i-1] (bucket 0 starts at 0);
+        # the +Inf bucket's lower edge is the last finite bound
+        first = next(
+            (
+                i
+                for i in range(1, len(delta))
+                if float(bounds[i - 1]) >= threshold
+            ),
+            None,
+        )
+        if first is None:
+            return None
+        slow = sum(delta[first:])
+        if slow <= 0:
+            return None
+        return {
+            "histogram": self.histogram,
+            "threshold_s": self.threshold_s,
+            "slow_observations": int(slow),
+        }
+
+
+@dataclasses.dataclass
+class P99Regression:
+    """Fire when the LAST interval's estimated p99 of ``histogram``
+    exceeds ``factor`` x the rolling window's baseline p99 (estimated
+    from bucket deltas; needs ``min_count`` fresh observations so a
+    lone outlier in an idle fabric does not page anyone)."""
+
+    histogram: str
+    factor: float = 3.0
+    min_count: int = 16
+
+    @property
+    def name(self) -> str:
+        return f"p99:{self.histogram}x{self.factor}"
+
+    def check(self, prev: dict, cur: dict, window=None) -> Optional[dict]:
+        h1 = cur.get("histograms", {}).get(self.histogram)
+        h0 = prev.get("histograms", {}).get(self.histogram)
+        if h1 is None or h0 is None:
+            return None
+        delta, total = _hist_delta(h1, h0)
+        if total < self.min_count:
+            return None
+        # baseline: everything observed BEFORE this interval (the
+        # oldest snapshot in the rolling window up to prev)
+        base = h0
+        if window:
+            oldest = window[0][1].get("histograms", {}).get(self.histogram)
+            if oldest is not None:
+                base = oldest
+        base_counts = base["counts"]
+        if sum(base_counts) < self.min_count:
+            return None
+        p99_now = _estimate_p99(h1["buckets"], delta)
+        p99_base = _estimate_p99(base["buckets"], base_counts)
+        if p99_base <= 0 or p99_now < self.factor * p99_base:
+            return None
+        return {
+            "histogram": self.histogram,
+            "p99_now_s": p99_now,
+            "p99_baseline_s": p99_base,
+            "factor": self.factor,
+            "interval_count": int(total),
+        }
+
+
+@dataclasses.dataclass
+class CounterSpike:
+    """Fire when a monotonic counter advanced at all since the last
+    check — the shape of recovery escalations (``install_resyncs_total``,
+    ``install_retry_giveups_total``) and ``barrier_timeouts_total``,
+    where every increment IS an incident worth a bundle."""
+
+    counter: str
+
+    @property
+    def name(self) -> str:
+        return f"counter:{self.counter}"
+
+    def check(self, prev: dict, cur: dict, window=None) -> Optional[dict]:
+        d = cur.get("counters", {}).get(self.counter, 0) - prev.get(
+            "counters", {}
+        ).get(self.counter, 0)
+        if d <= 0:
+            return None
+        return {"counter": self.counter, "delta": int(d)}
+
+
+#: the escalation/timeout triggers armed by default with the recorder —
+#: each increment of these is an incident, not a statistic
+DEFAULT_COUNTER_TRIGGERS = (
+    "install_resyncs_total",
+    "install_retry_giveups_total",
+    "barrier_timeouts_total",
+)
+
+
+class FlightRecorder:
+    """Bounded in-memory flight recorder (see module docstring).
+
+    Lifecycle: construct, add triggers/context providers, :meth:`arm`
+    (installs the trace tee + arms registry exemplars), then drive
+    :meth:`snapshot_tick` once per Monitor pass (the Controller
+    subscribes it to ``EventStatsFlush``). ``disarm`` detaches the tee;
+    the captured state stays readable."""
+
+    def __init__(
+        self,
+        max_trees: int = 64,
+        max_records: int = 8192,
+        max_snapshots: int = 32,
+        max_events: int = 512,
+        dump_dir: str = "",
+        max_dumps: int = 32,
+        registry=REGISTRY,
+        clock=time.time,
+    ) -> None:
+        self.registry = registry
+        self.clock = clock
+        self.max_trees = int(max_trees)
+        self.dump_dir = dump_dir
+        self.max_dumps = int(max_dumps)
+        #: completed trees: root span id -> {"root", "t", "nodes"}
+        self._trees: "collections.OrderedDict[int, dict]" = (
+            collections.OrderedDict()
+        )
+        #: member span id -> root id (evicted with its tree)
+        self._span_root: dict[int, int] = {}
+        #: spans whose tree has not completed yet: id -> record
+        self._open: dict[int, dict] = {}
+        self._children: dict[int, list[int]] = {}
+        self._links: dict[int, list[int]] = {}
+        self._max_open = int(max_records)
+        #: rolling (ts, registry snapshot) window — the trigger baseline
+        self._snapshots: collections.deque = collections.deque(
+            maxlen=int(max_snapshots)
+        )
+        #: bus-event tail: (ts, event type name)
+        self._events: collections.deque = collections.deque(
+            maxlen=int(max_events)
+        )
+        self.triggers: list = []
+        #: name -> zero-arg callable merged into every frozen bundle
+        #: (TopologyDB epoch/dirty state, in-flight window census, ...)
+        self.context: dict[str, Callable[[], dict]] = {}
+        #: hook fired per frozen bundle: on_anomaly(bundle) — the
+        #: Controller publishes EventAnomaly through it
+        self.on_anomaly: Optional[Callable[[dict], None]] = None
+        #: frozen bundles, newest last (also on disk when dump_dir set)
+        self.bundles: collections.deque = collections.deque(maxlen=8)
+        self.n_dumped = 0
+        self._seq = 0
+        self._armed = False
+        #: manual (pull-RPC) freezes within this window return the last
+        #: manual bundle instead of re-snapshotting: freeze() copies
+        #: trees + runs context providers + maybe writes a file, all on
+        #: the control-plane thread — a client hammering flight_dump()
+        #: must not stall barrier/echo handling (DoS guard)
+        self.manual_cooldown_s = 1.0
+        self._last_manual: Optional[dict] = None
+        self._t_last_manual = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def arm(self) -> "FlightRecorder":
+        """Start recording: tee the trace stream here and arm registry
+        exemplars. Registers this instance as the process default
+        (:data:`RECORDER`) for the bench dump hook and pull-mode RPC.
+        ONE recorder is active at a time: arming disarms the previous
+        default, so a process that constructs successive Controllers
+        (checkpoint restore, tests) never accumulates dead recorders
+        ingesting every span and pinning their controllers' object
+        graphs through the context-provider closures."""
+        global RECORDER
+        from sdnmpi_tpu.utils import tracing
+
+        if RECORDER is not None and RECORDER is not self:
+            RECORDER.disarm()
+        if not self._armed:
+            tracing.add_trace_sink(self.record)
+            self.registry.arm_exemplars()
+            self._armed = True
+        RECORDER = self
+        return self
+
+    def disarm(self) -> None:
+        from sdnmpi_tpu.utils import tracing
+
+        tracing.remove_trace_sink(self.record)
+        self._armed = False
+
+    def add_counter_triggers(
+        self, counters=DEFAULT_COUNTER_TRIGGERS
+    ) -> None:
+        for c in counters:
+            self.triggers.append(CounterSpike(c))
+
+    def add_context(self, name: str, fn: Callable[[], dict]) -> None:
+        self.context[name] = fn
+
+    # -- ingest ------------------------------------------------------------
+
+    def record(self, rec: dict) -> None:
+        """Trace-sink tee: fold one record into the live tree assembly.
+        Span records buffer until their tree's ROOT ends; a root end
+        freezes the reachable tree into the bounded ring, and spans
+        ending AFTER their root (the coalescer's window spans outlive
+        the first parked packet's root span) are adopted into the
+        already-completed tree. Non-span records are ignored — the
+        event tail has its own tap."""
+        kind = rec.get("kind")
+        if kind == "span":
+            sid = rec["span"]
+            parent = rec.get("parent", 0)
+            root = self._span_root.get(parent) if parent else None
+            if root is not None and root in self._trees:
+                # late child of a completed tree: adopt it (and any of
+                # ITS descendants that ended even earlier and buffered)
+                self._collect(self._trees[root], sid, rec)
+                tree_parent = self._trees[root]["nodes"].get(parent)
+                if tree_parent is not None and sid not in tree_parent[
+                    "children"
+                ]:
+                    tree_parent["children"].append(sid)
+                return
+            self._open[sid] = rec
+            if parent:
+                self._children.setdefault(parent, []).append(sid)
+            else:
+                self._complete(sid)
+            if len(self._open) > self._max_open:
+                # a span whose root never ends (bug or crash mid-burst)
+                # must not grow the buffer forever: shed oldest-first
+                dead = next(iter(self._open))
+                self._evict_open(dead)
+        elif kind == "span_link":
+            sid = rec["span"]
+            root = self._span_root.get(sid)
+            if root is not None and root in self._trees:
+                self._trees[root]["nodes"][sid]["links"].append(
+                    rec["parent"]
+                )
+            else:
+                self._links.setdefault(sid, []).append(rec["parent"])
+
+    def event_tap(self, event) -> None:
+        """Bus tap: remember the event-type tail (cause context for
+        bundles). One tuple append per event — cheap enough to stay on
+        even at soak rates."""
+        self._events.append((round(self.clock(), 6), type(event).__name__))
+
+    def _evict_open(self, sid: int) -> None:
+        self._open.pop(sid, None)
+        self._children.pop(sid, None)
+        self._links.pop(sid, None)
+
+    def _collect(self, tree: dict, start: int, rec: dict) -> None:
+        """Fold ``start`` (record ``rec``) plus every BUFFERED span
+        reachable from it into ``tree`` (descendants that ended before
+        their parent sit in ``_open`` keyed under it)."""
+        root = tree["root"]
+        stack = [(start, rec)]
+        while stack:
+            sid, r = stack.pop()
+            kids = self._children.pop(sid, [])
+            tree["nodes"][sid] = {
+                **r,
+                "children": sorted(kids),
+                "links": sorted(self._links.pop(sid, [])),
+            }
+            self._span_root[sid] = root
+            for kid in kids:
+                kid_rec = self._open.pop(kid, None)
+                if kid_rec is not None:
+                    stack.append((kid, kid_rec))
+
+    def _complete(self, root: int) -> None:
+        """A root span ended: collect every buffered span reachable from
+        it into one tree node map and retire it into the ring."""
+        rec = self._open.pop(root, None)
+        if rec is None:
+            return
+        tree = {"root": root, "t": round(self.clock(), 6), "nodes": {}}
+        self._collect(tree, root, rec)
+        self._trees[root] = tree
+        while len(self._trees) > self.max_trees:
+            old_root, old = self._trees.popitem(last=False)
+            for sid in old["nodes"]:
+                self._span_root.pop(sid, None)
+        _m_trees.set(len(self._trees))
+
+    # -- reads -------------------------------------------------------------
+
+    def trees(self) -> list[dict]:
+        """Retained trees, oldest first."""
+        return list(self._trees.values())
+
+    def tree_for(self, span_id: int) -> Optional[dict]:
+        """The completed tree containing ``span_id`` (exemplar
+        resolution: histogram bucket -> span id -> request tree)."""
+        root = self._span_root.get(span_id)
+        return self._trees.get(root) if root is not None else None
+
+    # -- trigger cadence ---------------------------------------------------
+
+    def snapshot_tick(self, now: Optional[float] = None) -> list[dict]:
+        """One trigger pass (per EventStatsFlush): snapshot the
+        registry, evaluate every trigger against the previous snapshot,
+        freeze a bundle per firing. Returns the bundles frozen by this
+        tick (empty almost always)."""
+        now = self.clock() if now is None else now
+        cur = self.registry.snapshot()
+        fired: list[dict] = []
+        if self._snapshots:
+            prev = self._snapshots[-1][1]
+            for trigger in self.triggers:
+                try:
+                    detail = trigger.check(prev, cur, self._snapshots)
+                except Exception:  # a broken predicate must not take
+                    continue  # the Monitor cadence down with it
+                if detail is not None:
+                    fired.append(
+                        self.freeze(trigger.name, detail, snapshot=cur)
+                    )
+        self._snapshots.append((round(now, 6), cur))
+        return fired
+
+    # -- bundles -----------------------------------------------------------
+
+    def freeze(
+        self, trigger: str, detail: dict, snapshot: Optional[dict] = None
+    ) -> dict:
+        """Freeze one diagnostic bundle NOW (also the pull-mode RPC's
+        ``flight_dump`` entry point, with trigger="manual" — manual
+        freezes inside ``manual_cooldown_s`` return the previous manual
+        bundle instead of paying the snapshot again)."""
+        if trigger == "manual":
+            now = self.clock()
+            if (
+                self._last_manual is not None
+                and now - self._t_last_manual < self.manual_cooldown_s
+            ):
+                return self._last_manual
+            self._t_last_manual = now
+        cur = self.registry.snapshot() if snapshot is None else snapshot
+        prev = self._snapshots[-1][1] if self._snapshots else {}
+        self._seq += 1
+        bundle = {
+            "seq": self._seq,
+            "trigger": trigger,
+            "detail": detail,
+            "ts": round(self.clock(), 6),
+            "span_trees": self.trees(),
+            "metrics": cur,
+            "metrics_delta": _snapshot_delta(cur, prev),
+            "exemplars": {
+                name: h["exemplars"]
+                for name, h in cur.get("histograms", {}).items()
+                if h.get("exemplars")
+            },
+            "events_tail": [list(e) for e in self._events],
+        }
+        for name, fn in self.context.items():
+            try:
+                bundle[name] = fn()
+            except Exception as e:  # context is best-effort forensics
+                bundle[name] = {"error": repr(e)}
+        _m_anomalies.inc(trigger)
+        path = self._dump(bundle)
+        if path is not None:
+            bundle["path"] = path
+        if trigger == "manual":
+            self._last_manual = bundle
+        self.bundles.append(bundle)
+        if self.on_anomaly is not None:
+            try:
+                self.on_anomaly(bundle)
+            except Exception:
+                pass
+        return bundle
+
+    def _dump(self, bundle: dict) -> Optional[str]:
+        if not self.dump_dir or self.n_dumped >= self.max_dumps:
+            return None
+        d = pathlib.Path(self.dump_dir)
+        d.mkdir(parents=True, exist_ok=True)
+        slug = "".join(
+            c if c.isalnum() else "_" for c in bundle["trigger"]
+        )[:48]
+        path = d / f"flight_{bundle['seq']:04d}_{slug}.json"
+        with path.open("w") as f:
+            json.dump(bundle, f, default=json_default)
+        self.n_dumped += 1
+        _m_dumps.inc()
+        return str(path)
+
+    def reset(self) -> None:
+        """Drop every captured window (tests)."""
+        self._trees.clear()
+        self._span_root.clear()
+        self._open.clear()
+        self._children.clear()
+        self._links.clear()
+        self._snapshots.clear()
+        self._events.clear()
+        self.bundles.clear()
+        _m_trees.set(0)
+
+
+def json_default(obj):
+    """Last-resort JSON encoding for context-provider values (numpy
+    scalars, sets) so a bundle dump can never raise mid-incident — also
+    the ``default=`` the RPC pull path uses to serialize the same
+    bundles over the wire."""
+    if isinstance(obj, (set, frozenset)):
+        return sorted(obj)
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if fn is not None:
+            try:
+                return fn()
+            except Exception:
+                pass
+    return repr(obj)
+
+
+def _snapshot_delta(cur: dict, prev: dict) -> dict:
+    """Counter/histogram-count movement between two snapshots — the
+    'what changed this interval' half of a bundle."""
+    out = {"counters": {}, "histogram_counts": {}}
+    pc = prev.get("counters", {})
+    for name, v in cur.get("counters", {}).items():
+        d = v - pc.get(name, 0)
+        if d:
+            out["counters"][name] = d
+    ph = prev.get("histograms", {})
+    for name, h in cur.get("histograms", {}).items():
+        d = h["count"] - ph.get(name, {}).get("count", 0)
+        if d:
+            out["histogram_counts"][name] = d
+    return out
+
+
+def install_env_dump_hook() -> bool:
+    """Arm an interpreter-exit dump to ``$SDNMPI_FLIGHT_DUMP`` when the
+    env var is set (the bench runner's ``--flight-dump`` plumbing: any
+    config whose run tripped an anomaly trigger leaves its bundles
+    beside the bench JSON). Dumps the process-default recorder's frozen
+    bundles — or a minimal "no recorder armed" marker, so a missing
+    file never reads as "no anomalies". Returns True when armed."""
+    import atexit
+    import os
+
+    path = os.environ.get(DUMP_ENV)
+    if not path:
+        return False
+
+    def _dump() -> None:
+        rec = RECORDER
+        payload = {
+            "armed": rec is not None,
+            "bundles": list(rec.bundles) if rec is not None else [],
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, default=json_default)
+
+    atexit.register(_dump)
+    return True

@@ -53,6 +53,12 @@ SIZE_BUCKETS = (
     1, 4, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576
 )
 
+#: the ACTIVE span id, written by utils/tracing's Span lifecycle and
+#: read by :meth:`Histogram.observe` when exemplars are armed — a one-
+#: element list so metrics (imported by tracing) never imports tracing
+#: back. 0 = no active span (tracing off, or between requests).
+CURRENT_SPAN = [0]
+
 
 class Counter:
     """Monotonic counter. ``inc`` is one attribute add — hot-path safe."""
@@ -91,9 +97,18 @@ class Gauge:
 class Histogram:
     """Fixed-bucket histogram: ``bounds[i]`` is bucket i's inclusive
     upper edge; the final bucket is +Inf. ``observe`` is a bisect plus
-    two adds — no allocation, no lock (see module docstring)."""
+    two adds — no allocation, no lock (see module docstring).
 
-    __slots__ = ("name", "help", "bounds", "counts", "sum", "count")
+    **Exemplars** (ISSUE 7): when :meth:`arm_exemplars` has been called,
+    each bucket additionally remembers the span id active at its most
+    recent observation (the flight recorder resolves the id back to a
+    full span tree, so a Prometheus latency spike becomes a concrete
+    request trace). Unarmed — the default — ``exemplars`` is None and
+    ``observe`` pays exactly one attribute load + is-None test extra:
+    no per-observe allocation, the PR-4 hot-path contract."""
+
+    __slots__ = ("name", "help", "bounds", "counts", "sum", "count",
+                 "exemplars")
 
     def __init__(
         self, name: str, buckets=LATENCY_BUCKETS_S, help: str = ""
@@ -106,11 +121,30 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        #: per-bucket span id of the latest observation (None = unarmed)
+        self.exemplars: Optional[list] = None
 
-    def observe(self, value: float) -> None:
-        self.counts[bisect_left(self.bounds, value)] += 1
+    def observe(self, value: float, exemplar: int = 0) -> None:
+        i = bisect_left(self.bounds, value)
+        self.counts[i] += 1
         self.sum += value
         self.count += 1
+        ex = self.exemplars
+        if ex is not None:
+            # fixed per-bucket slot, overwritten in place — the armed
+            # path allocates nothing per observe either. ``exemplar``
+            # lets sites whose spans have already closed attribute
+            # explicitly (Router's flush e2e sample passes its last
+            # window span id); everyone else inherits the tracing
+            # layer's active span.
+            sid = exemplar or CURRENT_SPAN[0]
+            if sid:
+                ex[i] = sid
+
+    def arm_exemplars(self) -> None:
+        """Start recording per-bucket exemplar span ids (idempotent)."""
+        if self.exemplars is None:
+            self.exemplars = [0] * (len(self.bounds) + 1)
 
 
 class LabeledCounter:
@@ -148,6 +182,7 @@ class MetricsRegistry:
 
         self._metrics: dict[str, object] = {}
         self._lock = threading.Lock()
+        self._exemplars_armed = False
 
     def _get_or_make(self, name: str, kind, *args, **kwargs):
         with self._lock:
@@ -160,8 +195,21 @@ class MetricsRegistry:
                     )
                 return existing
             metric = kind(name, *args, **kwargs)
+            if self._exemplars_armed and isinstance(metric, Histogram):
+                metric.arm_exemplars()  # late registrations join armed
             self._metrics[name] = metric
             return metric
+
+    def arm_exemplars(self) -> None:
+        """Arm per-bucket exemplar capture on every histogram, present
+        and future (the flight recorder arms this once when it starts;
+        the unarmed default keeps the PR-4 zero-allocation observe)."""
+        with self._lock:
+            self._exemplars_armed = True
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                m.arm_exemplars()
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get_or_make(name, Counter, help)
@@ -217,12 +265,15 @@ class MetricsRegistry:
             elif isinstance(m, Gauge):
                 gauges[name] = m.value
             elif isinstance(m, Histogram):
-                histograms[name] = {
+                h = {
                     "buckets": list(m.bounds),
                     "counts": list(m.counts),
                     "sum": m.sum,
                     "count": m.count,
                 }
+                if m.exemplars is not None:
+                    h["exemplars"] = list(m.exemplars)
+                histograms[name] = h
             elif isinstance(m, LabeledCounter):
                 counters.update(
                     {
@@ -250,6 +301,8 @@ class MetricsRegistry:
                 m.counts = [0] * (len(m.bounds) + 1)
                 m.sum = 0.0
                 m.count = 0
+                if m.exemplars is not None:
+                    m.exemplars = [0] * (len(m.bounds) + 1)
             elif isinstance(m, LabeledCounter):
                 m.values.clear()
 

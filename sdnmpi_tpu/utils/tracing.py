@@ -37,10 +37,15 @@ import threading
 import time
 from typing import Callable, Optional
 
-from sdnmpi_tpu.utils.metrics import REGISTRY
+from sdnmpi_tpu.utils.metrics import CURRENT_SPAN, REGISTRY
 
 _sink: Optional[Callable[[dict], None]] = None
 _sink_file = None  # open handle when the sink is file-based
+#: additional tee'd sinks (the flight recorder, the --trace-dump
+#: collector) delivered beside the primary sink; spans are live when
+#: EITHER channel is armed. Kept separate from set_trace_sink so the
+#: recorder can attach/detach without disturbing a file sink's handle.
+_extra_sinks: list = []
 _sink_errors = REGISTRY.counter(
     "trace_sink_errors_total",
     "trace sink callables that raised (record dropped, sink kept)",
@@ -64,19 +69,41 @@ def set_trace_sink(path_or_fn) -> None:
         _sink = lambda rec: (f.write(json.dumps(rec) + "\n"), f.flush())  # noqa: E731
 
 
+def add_trace_sink(fn: Callable[[dict], None]) -> None:
+    """Attach an additional sink (tee). Idempotent per callable."""
+    if fn not in _extra_sinks:
+        _extra_sinks.append(fn)
+
+
+def remove_trace_sink(fn: Callable[[dict], None]) -> None:
+    """Detach a tee'd sink installed by :func:`add_trace_sink`."""
+    if fn in _extra_sinks:
+        _extra_sinks.remove(fn)
+
+
+def _deliver(sink, rec: dict) -> None:
+    try:
+        sink(rec)
+    except Exception:
+        _sink_errors.inc()
+        logging.getLogger("tracing").debug(
+            "trace sink raised; record dropped", exc_info=True
+        )
+
+
 def trace_event(kind: str, **fields) -> None:
     """Emit one structured trace record (no-op without a sink). A sink
     that raises drops the record — never the caller: the sink is a tap
     on the control plane, and a broken exporter must not take the bus
-    handler that happened to emit through it down with it."""
-    if _sink is not None:
-        try:
-            _sink({"ts": time.time(), "kind": kind, **fields})
-        except Exception:
-            _sink_errors.inc()
-            logging.getLogger("tracing").debug(
-                "trace sink raised; record dropped", exc_info=True
-            )
+    handler that happened to emit through it down with it. Each tee'd
+    sink is guarded independently, so one broken exporter cannot starve
+    the others of the record."""
+    if _sink is not None or _extra_sinks:
+        rec = {"ts": time.time(), "kind": kind, **fields}
+        if _sink is not None:
+            _deliver(_sink, rec)
+        for sink in _extra_sinks:
+            _deliver(sink, rec)
 
 
 # -- request-scoped spans --------------------------------------------------
@@ -105,6 +132,9 @@ class Span:
         self.t0 = time.perf_counter()
         self.fields = fields
         self._done = False
+        # exemplar attribution: histogram observations inside this
+        # span's scope pick up its id (utils/metrics.CURRENT_SPAN)
+        CURRENT_SPAN[0] = self.id
 
     def child(self, name: str, **fields) -> "Span":
         return start_span(name, parent=self, **fields)
@@ -122,6 +152,10 @@ class Span:
         if self._done:
             return
         self._done = True
+        if CURRENT_SPAN[0] == self.id:
+            # restore the enclosing span for later observations (only
+            # when still active: parked spans end out of LIFO order)
+            CURRENT_SPAN[0] = self.parent
         t1 = time.perf_counter()
         trace_event(
             "span",
@@ -161,7 +195,7 @@ def start_span(name: str, parent=None, **fields):
     """Open a span (returns :data:`NULL_SPAN` when tracing is off).
     ``parent`` is a Span or None (root). The caller owns the lifecycle:
     call ``end()`` when the stage completes."""
-    if _sink is None:
+    if _sink is None and not _extra_sinks:
         return NULL_SPAN
     pid = 0 if parent is None else parent.id
     return Span(name, pid, **fields)

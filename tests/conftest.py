@@ -25,3 +25,19 @@ import jax  # noqa: E402
 
 if not os.environ.get("SDNMPI_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _flight_isolation():
+    """Controllers arm the process-global flight recorder tee (ISSUE 7:
+    Config.flight_recorder defaults on); detach whatever a test's
+    controllers left armed so span liveness — and therefore tests that
+    assert the NULL_SPAN fast path — never leaks across tests."""
+    yield
+    from sdnmpi_tpu.utils import flight, metrics, tracing
+
+    tracing._extra_sinks.clear()
+    metrics.CURRENT_SPAN[0] = 0
+    flight.RECORDER = None

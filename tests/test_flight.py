@@ -1,0 +1,792 @@
+"""Flight recorder, anomaly triggers, histogram exemplars, pull-mode
+RPC, congestion analytics, and Perfetto export (ISSUE 7).
+
+The acceptance spine: a seeded chaos soak with anomaly triggers armed
+must freeze >=1 diagnostic bundle whose exemplar resolves to the span
+tree of a slow request (sim + wire); the jitted congestion-analytics
+pass must add zero recompiles across a 100-step churn replay; and the
+recorder/exemplar hot paths must stay inside the PR-4 metrics overhead
+bound.
+"""
+
+import json
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.utils.flight import (
+    CounterSpike,
+    FlightRecorder,
+    HistogramThreshold,
+    P99Regression,
+)
+from sdnmpi_tpu.utils.metrics import REGISTRY, Histogram, MetricsRegistry
+
+MACS = [f"04:00:00:00:00:0{i}" for i in range(1, 5)]
+
+
+def small_stack(wire: bool = False, **overrides):
+    """Two switches, four hosts, coalescing + monitor — the smallest
+    stack whose packet-ins produce full pipeline span trees."""
+    from sdnmpi_tpu.control.fabric import Fabric
+
+    fabric = Fabric(wire=wire)
+    for dpid in (1, 2):
+        fabric.add_switch(dpid)
+    fabric.add_link(1, 1, 2, 1)
+    hosts = [
+        fabric.add_host(MACS[0], 1, 2),
+        fabric.add_host(MACS[1], 1, 3),
+        fabric.add_host(MACS[2], 2, 2),
+        fabric.add_host(MACS[3], 2, 3),
+    ]
+    config = Config(
+        oracle_backend="py", coalesce_routes=True,
+        coalesce_window_s=10.0, **overrides,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    return fabric, controller, hosts
+
+
+def span_record(sid, parent=0, name="stage", **fields):
+    return {
+        "ts": 0.0, "kind": "span", "name": name, "span": sid,
+        "parent": parent, "t0": float(sid), "t1": float(sid) + 0.5,
+        "wall_ms": 500.0, **fields,
+    }
+
+
+class TestTreeAssembly:
+    def test_children_before_root(self):
+        rec = FlightRecorder()
+        rec.record(span_record(2, parent=1, name="child"))
+        rec.record(span_record(3, parent=2, name="grandchild"))
+        rec.record(span_record(1, name="root"))
+        (tree,) = rec.trees()
+        assert tree["root"] == 1
+        assert sorted(tree["nodes"]) == [1, 2, 3]
+        assert tree["nodes"][1]["children"] == [2]
+        assert tree["nodes"][2]["children"] == [3]
+        assert rec.tree_for(3) is tree
+
+    def test_late_children_adopted_after_root_end(self):
+        """The coalescer's window spans END after the first packet's
+        root span ends — they must still join the completed tree."""
+        rec = FlightRecorder()
+        rec.record(span_record(1, name="packet_in"))
+        rec.record(span_record(2, parent=1, name="route_window"))
+        rec.record(span_record(3, parent=2, name="install"))
+        (tree,) = rec.trees()
+        assert sorted(tree["nodes"]) == [1, 2, 3]
+        assert tree["nodes"][1]["children"] == [2]
+        assert rec.tree_for(3) is tree
+
+    def test_buffered_descendants_of_late_child(self):
+        """dispatch ends before its window span, which ends after the
+        root: the window's adoption must drag the buffered dispatch
+        along with it."""
+        rec = FlightRecorder()
+        rec.record(span_record(1, name="packet_in"))  # root completes
+        rec.record(span_record(3, parent=2, name="dispatch"))  # buffers
+        rec.record(span_record(2, parent=1, name="route_window"))
+        (tree,) = rec.trees()
+        assert sorted(tree["nodes"]) == [1, 2, 3]
+        assert tree["nodes"][2]["children"] == [3]
+
+    def test_fan_in_links_recorded(self):
+        rec = FlightRecorder()
+        rec.record({"kind": "span_link", "span": 2, "parent": 9})
+        rec.record(span_record(2, parent=1, name="window"))
+        rec.record(span_record(1, name="root"))
+        (tree,) = rec.trees()
+        assert tree["nodes"][2]["links"] == [9]
+
+    def test_tree_ring_bounded(self):
+        rec = FlightRecorder(max_trees=8)
+        for sid in range(1, 101):
+            rec.record(span_record(sid, name=f"root{sid}"))
+        assert len(rec.trees()) == 8
+        assert rec.tree_for(1) is None  # evicted with its tree
+        assert rec.tree_for(100) is not None
+        assert len(rec._span_root) == 8
+
+    def test_orphan_spans_shed(self):
+        """Spans whose root never ends must not grow memory forever."""
+        rec = FlightRecorder(max_records=64)
+        for sid in range(1, 1001):
+            rec.record(span_record(sid, parent=99999))  # root never ends
+        assert len(rec._open) <= 64
+
+    def test_memory_bounded_under_sustained_ingest(self):
+        """100k span records against every bounded window: retained
+        growth must flatline (the recorder is a ring, not a log)."""
+        rec = FlightRecorder(max_trees=16, max_records=256)
+        for sid in range(1, 5001):  # warm the rings to their caps
+            rec.record(span_record(sid, name="r"))
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for sid in range(5001, 105001):
+            rec.record(span_record(sid, name="r"))
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        growth = sum(
+            s.size_diff for s in after.compare_to(before, "filename")
+            if s.size_diff > 0
+        )
+        assert growth < 256 * 1024, f"retained {growth} bytes"
+
+
+class TestTriggers:
+    def _snap(self, counts, counters=None):
+        return {
+            "counters": counters or {},
+            "gauges": {},
+            "histograms": {
+                "lat_seconds": {
+                    "buckets": [0.001, 0.01, 0.1, 1.0],
+                    "counts": list(counts),
+                    "sum": 0.0,
+                    "count": sum(counts),
+                }
+            },
+        }
+
+    def test_histogram_threshold_fires_only_provably_above(self):
+        trig = HistogramThreshold("lat_seconds", 0.01)
+        prev = self._snap([5, 5, 0, 0, 0])
+        # new observations in the (0.001, 0.01] bucket straddle the
+        # threshold -> must NOT fire
+        assert trig.check(prev, self._snap([5, 9, 0, 0, 0])) is None
+        # a count landing above 0.01's lower edge -> fires
+        fired = trig.check(prev, self._snap([5, 5, 2, 0, 0]))
+        assert fired is not None and fired["slow_observations"] == 2
+        # +Inf bucket counts too
+        assert trig.check(prev, self._snap([5, 5, 0, 0, 1])) is not None
+
+    def test_histogram_threshold_clamps_past_last_bucket(self):
+        """A threshold beyond the last finite edge clamps to it instead
+        of silently never firing: a 60s install must still page even
+        with --anomaly-latency-threshold 10 on 1s-max buckets."""
+        trig = HistogramThreshold("lat_seconds", 10.0)
+        prev = self._snap([5, 0, 0, 0, 0])
+        fired = trig.check(prev, self._snap([5, 0, 0, 0, 1]))
+        assert fired is not None and fired["slow_observations"] == 1
+
+    def test_counter_spike(self):
+        trig = CounterSpike("install_resyncs_total")
+        prev = self._snap([0] * 5, {"install_resyncs_total": 2})
+        assert trig.check(
+            prev, self._snap([0] * 5, {"install_resyncs_total": 2})
+        ) is None
+        fired = trig.check(
+            prev, self._snap([0] * 5, {"install_resyncs_total": 4})
+        )
+        assert fired == {"counter": "install_resyncs_total", "delta": 2}
+
+    def test_p99_regression(self):
+        trig = P99Regression("lat_seconds", factor=3.0, min_count=16)
+        base = self._snap([100, 0, 0, 0, 0])  # p99 ~ 1ms history
+        calm = self._snap([120, 0, 0, 0, 0])
+        assert trig.check(base, calm) is None
+        spike = self._snap([100, 0, 20, 0, 0])  # interval p99 ~ 100ms
+        fired = trig.check(base, spike)
+        assert fired is not None
+        assert fired["p99_now_s"] == pytest.approx(0.1)
+
+    def test_snapshot_tick_freezes_bundle_and_fires_hook(self, tmp_path):
+        reg = MetricsRegistry()
+        c = reg.counter("install_resyncs_total")
+        rec = FlightRecorder(dump_dir=str(tmp_path), registry=reg)
+        rec.triggers.append(CounterSpike("install_resyncs_total"))
+        seen = []
+        rec.on_anomaly = seen.append
+        assert rec.snapshot_tick() == []  # first tick: baseline only
+        c.inc()
+        (bundle,) = rec.snapshot_tick()
+        assert bundle["trigger"] == "counter:install_resyncs_total"
+        assert bundle["detail"]["delta"] == 1
+        assert seen == [bundle]
+        # dumped beside the seq/trigger slug, valid JSON
+        files = list(tmp_path.glob("flight_*.json"))
+        assert len(files) == 1
+        on_disk = json.loads(files[0].read_text())
+        assert on_disk["trigger"] == bundle["trigger"]
+        assert (
+            on_disk["metrics_delta"]["counters"]["install_resyncs_total"]
+            == 1
+        )
+
+
+class TestExemplarRoundTrip:
+    def test_spike_resolves_to_span_tree(self):
+        """Histogram bucket -> exemplar span id -> the flight
+        recorder's completed tree of the actual request — the
+        spike-to-trace loop, end to end in-process."""
+        fabric, controller, hosts = small_stack()
+        h = REGISTRY.get("pipeline_install_seconds")
+        assert h.exemplars is not None  # armed by the recorder
+        # the histogram is process-global: clear slots left by earlier
+        # tests' requests so every surviving exemplar is OURS
+        h.exemplars = [0] * (len(h.bounds) + 1)
+        hosts[0].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"x",
+        ))
+        sids = [e for e in h.exemplars if e]
+        assert sids, "no exemplar recorded for the install sample"
+        tree = controller.flight.tree_for(sids[-1])
+        assert tree is not None
+        names = {n["name"] for n in tree["nodes"].values()}
+        assert tree["nodes"][tree["root"]]["name"] == "packet_in"
+        assert "southbound_send" in names
+        # and the pull-mode seam resolves the same id over the bus
+        reply = controller.bus.request(ev.SpanTreeRequest(sids[-1]))
+        assert reply.tree is tree
+
+    def test_no_exemplars_without_recorder(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("plain_seconds")
+        h.observe(0.005)
+        assert h.exemplars is None
+        assert "exemplars" not in reg.snapshot()["histograms"][
+            "plain_seconds"
+        ]
+
+
+class TestAnomalyEndToEnd:
+    def test_latency_trigger_freezes_bundle_and_broadcasts(self, tmp_path):
+        from sdnmpi_tpu.api.rpc import RPCInterface
+
+        fabric, controller, hosts = small_stack(
+            enable_monitor=True,
+            flight_dump_dir=str(tmp_path),
+            # every real install e2e is > 100us: the first Monitor pass
+            # after traffic must trip the latency trigger
+            flight_latency_threshold_s=0.0001,
+        )
+        rpc = RPCInterface(controller.bus, controller.config)
+        received = []
+
+        class Client:
+            def send_json(self, message):
+                received.append(message)
+
+        rpc.clients.append(Client())
+        anomalies = []
+        controller.bus.subscribe(ev.EventAnomaly, anomalies.append)
+        controller.monitor.poll(now=1.0)  # baseline snapshot
+        hosts[0].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"x",
+        ))
+        controller.monitor.poll(now=2.0)
+        assert anomalies, "latency trigger did not fire"
+        assert anomalies[0].trigger.startswith("latency:")
+        assert anomalies[0].path is not None
+        assert list(tmp_path.glob("flight_*.json"))
+        pushes = [m for m in received if m["method"] == "anomaly"]
+        assert pushes and pushes[0]["params"][0] == anomalies[0].trigger
+        # the broadcast summary is JSON-safe (it just crossed send_json)
+        json.dumps(pushes[0]["params"][1], default=repr)
+        # the bundle census names the pipeline + topology context
+        bundle = controller.flight.bundles[-1]
+        assert "windows" in bundle and "topology" in bundle
+        assert bundle["windows"]["desired_flows"] >= 1
+        assert bundle["topology"]["version"] >= 1
+
+
+def _chaos_soak_with_recorder(wire: bool, seed: int, steps: int = 50):
+    """Compact chaos soak (the PR-5 harness) with the flight recorder's
+    default counter triggers armed: aggressive drops + one-retry budget
+    so escalations (giveups -> resyncs) genuinely happen."""
+    from sdnmpi_tpu.control.faults import FaultPlan
+    from sdnmpi_tpu.protocol.announcement import (
+        Announcement,
+        AnnouncementType,
+    )
+    from sdnmpi_tpu.topogen import fattree, host_mac
+
+    spec = fattree(4)
+    fabric = spec.to_fabric(wire=wire)
+    config = Config(
+        oracle_backend="py", proactive_collectives=False,
+        coalesce_routes=True, enable_monitor=True,
+        install_retry_backoff_s=0.0, barrier_timeout_s=0.0,
+        install_retry_max=1,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    macs = [host_mac(r) for r in range(8)]
+    for rank, mac in enumerate(macs):
+        fabric.hosts[mac].send(of.Packet(
+            eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP, ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        ))
+    plan = FaultPlan(
+        seed=seed,
+        p_send_drop=0.25, p_send_stall=0.05, p_send_truncate=0.05,
+        p_ack_drop=0.1, p_crash=0.05, p_redial=0.5, p_flap=0.08,
+        p_restore=0.5, p_release=0.5, max_crashed=2,
+    ).attach(fabric)
+    rng = np.random.default_rng(seed)
+    hosts = sorted(fabric.hosts)
+    for step in range(steps):
+        plan.step()
+        for _ in range(3):
+            a, b = rng.choice(len(hosts), size=2, replace=False)
+            ha, hb = fabric.hosts[hosts[a]], fabric.hosts[hosts[b]]
+            if ha.dpid in fabric.switches and hb.dpid in fabric.switches:
+                ha.send(of.Packet(
+                    eth_src=hosts[a], eth_dst=hosts[b],
+                    eth_type=of.ETH_TYPE_IP, payload=b"soak",
+                ))
+        controller.monitor.poll(now=float(step))
+        fabric.tick(float(step))
+    plan.quiesce()
+    controller.monitor.poll(now=float(steps))
+    return fabric, controller, plan
+
+
+class TestChaosSoakBundles:
+    """Acceptance: a seeded crash/stall soak produces >=1 diagnostic
+    bundle whose span trees contain the recovery escalation, with the
+    bundle's exemplars resolving into those same trees — sim and wire."""
+
+    @pytest.mark.parametrize("wire", [False, True], ids=["sim", "wire"])
+    def test_soak_produces_escalation_bundle(self, wire):
+        fabric, controller, plan = _chaos_soak_with_recorder(
+            wire=wire, seed=23
+        )
+        assert plan.counts["drop"] > 0
+        bundles = list(controller.flight.bundles)
+        assert bundles, "no anomaly bundle frozen during the soak"
+        assert any(
+            b["trigger"].startswith("counter:") for b in bundles
+        )
+        # the escalation is IN the frozen span trees
+        names = {
+            node["name"]
+            for b in bundles
+            for tree in b["span_trees"]
+            for node in tree["nodes"].values()
+        }
+        assert names & {"recovery_retry", "recovery_resync"}, names
+        # and at least one exemplar resolves to a span in the bundle's
+        # own trees (spike -> concrete trace, frozen together)
+        resolved = False
+        for b in bundles:
+            members = {
+                sid for tree in b["span_trees"] for sid in tree["nodes"]
+            }
+            for ex in b["exemplars"].values():
+                if any(sid in members for sid in ex if sid):
+                    resolved = True
+        assert resolved, "no exemplar resolved into the bundle's trees"
+
+
+class TestPullModeRPC:
+    def _rpc(self):
+        from sdnmpi_tpu.api.rpc import RPCInterface
+
+        fabric, controller, hosts = small_stack()
+        hosts[0].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"x",
+        ))
+        return RPCInterface(controller.bus, controller.config), controller
+
+    def test_telemetry_pull(self):
+        rpc, controller = self._rpc()
+        reply = rpc.handle_request(
+            {"jsonrpc": "2.0", "id": 7, "method": "telemetry"}
+        )
+        assert reply["id"] == 7
+        assert reply["result"]["counters"]["router_packet_ins_total"] >= 1
+        # same registry as the Controller's own snapshot
+        assert (
+            reply["result"]["counters"]["router_packet_ins_total"]
+            == controller.telemetry()["counters"][
+                "router_packet_ins_total"
+            ]
+        )
+
+    def test_span_tree_pull(self):
+        rpc, controller = self._rpc()
+        tree = controller.flight.trees()[-1]
+        reply = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 1, "method": "span_tree",
+            "params": [tree["root"]],
+        })
+        assert reply["result"]["root"] == tree["root"]
+        miss = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 2, "method": "span_tree",
+            "params": [999999],
+        })
+        assert miss["result"] is None
+
+    def test_flight_dump_pull(self):
+        rpc, controller = self._rpc()
+        reply = rpc.handle_request(
+            {"jsonrpc": "2.0", "id": 3, "method": "flight_dump"}
+        )
+        assert reply["result"]["trigger"] == "manual"
+        assert reply["result"]["span_trees"]
+
+    def test_unknown_method_and_notification(self):
+        rpc, _ = self._rpc()
+        err = rpc.handle_request(
+            {"jsonrpc": "2.0", "id": 4, "method": "nope"}
+        )
+        assert err["error"]["code"] == -32601
+        # notifications (no id) are ignored, never answered
+        assert rpc.handle_request({"method": "telemetry"}) is None
+
+    def test_bad_params(self):
+        rpc, _ = self._rpc()
+        err = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 5, "method": "span_tree",
+            "params": [],
+        })
+        assert err["error"]["code"] == -32602
+        # by-name params are legal JSON-RPC 2.0: unsupported here, but
+        # they must come back as bad params, not kill the connection
+        err = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 6, "method": "span_tree",
+            "params": {"span_id": 5},
+        })
+        assert err["error"]["code"] == -32602
+
+    def test_reply_with_numpy_context_serializes(self):
+        """A flight_dump bundle carrying numpy scalars / sets in its
+        context must serialize over the wire with the same last-resort
+        encoder the disk dump uses — not TypeError the socket down."""
+        from sdnmpi_tpu.utils.flight import json_default
+
+        rpc, controller = self._rpc()
+        controller.flight.add_context(
+            "odd", lambda: {"n": np.int64(3), "s": {1, 2}}
+        )
+        reply = rpc.handle_request(
+            {"jsonrpc": "2.0", "id": 9, "method": "flight_dump"}
+        )
+        out = json.dumps(reply, default=json_default)
+        assert json.loads(out)["result"]["odd"]["n"] == 3
+
+
+class TestPerfettoExport:
+    def _records(self):
+        fabric, controller, hosts = small_stack()
+        hosts[0].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"x",
+        ))
+        hosts[1].send(of.Packet(
+            eth_src=MACS[1], eth_dst=MACS[3], payload=b"y",
+        ))
+        return [
+            node
+            for tree in controller.flight.trees()
+            for node in tree["nodes"].values()
+        ]
+
+    def test_schema(self):
+        from sdnmpi_tpu.api.traceview import chrome_trace
+
+        records = self._records()
+        trace = chrome_trace(records)
+        assert set(trace) == {"traceEvents", "displayTimeUnit"}
+        events = trace["traceEvents"]
+        slices = [e for e in events if e["ph"] == "X"]
+        metas = [e for e in events if e["ph"] == "M"]
+        assert len(slices) == len(records)
+        for e in slices:
+            assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid",
+                              "tid"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        # one named track per request tree + the process name row
+        thread_rows = [e for e in metas if e["name"] == "thread_name"]
+        assert len(thread_rows) == len(
+            {e["tid"] for e in slices}
+        )
+        # the whole object is JSON-serializable as-is
+        json.dumps(trace)
+
+    def test_flow_events_pair_up(self):
+        from sdnmpi_tpu.api.traceview import chrome_trace
+
+        records = self._records() + [
+            # synthetic fan-in link between the two packet trees
+        ]
+        spans = [r for r in records if r.get("kind") == "span"]
+        a, b = spans[0]["span"], spans[-1]["span"]
+        records.append({"kind": "span_link", "span": a, "parent": b})
+        trace = chrome_trace(records)
+        starts = [e for e in trace["traceEvents"] if e["ph"] == "s"]
+        ends = [e for e in trace["traceEvents"] if e["ph"] == "f"]
+        assert len(starts) == len(ends) == 1
+        assert starts[0]["id"] == ends[0]["id"]
+
+    def test_convert_jsonl(self, tmp_path):
+        from sdnmpi_tpu.api.traceview import convert
+
+        src = tmp_path / "trace.jsonl"
+        src.write_text(
+            "\n".join(
+                json.dumps(span_record(s, name=f"s{s}"))
+                for s in range(1, 4)
+            )
+        )
+        out = tmp_path / "trace.json"
+        trace = convert(str(src), str(out))
+        assert len(
+            [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        ) == 3
+        assert json.loads(out.read_text()) == trace
+
+    def test_trace_collector_sink(self):
+        from sdnmpi_tpu.api.traceview import TraceCollector
+        from sdnmpi_tpu.utils import tracing
+
+        collector = TraceCollector()
+        tracing.add_trace_sink(collector)
+        try:
+            sp = tracing.start_span("collected")
+            sp.end()
+        finally:
+            tracing.remove_trace_sink(collector)
+        assert any(
+            r["name"] == "collected" for r in collector.records
+        )
+
+
+class TestHotPathOverhead:
+    """ISSUE 7 satellite: flight-recorder-era hot paths stay within the
+    PR-4 metrics bound — observe stays an attribute-write path, with no
+    per-observe allocation when no exemplar sink is armed (and none
+    retained when one IS)."""
+
+    N = 50_000
+
+    def test_observe_with_exemplar_slot_still_bounded(self):
+        import timeit
+
+        h = Histogram("bench_ex")
+        plain = timeit.timeit("x += 1", setup="x = 0", number=self.N)
+        unarmed = timeit.timeit(
+            "h.observe(0.005)", globals={"h": h}, number=self.N
+        )
+        assert unarmed < plain * 40  # the PR-4 bound, unchanged
+        h.arm_exemplars()
+        armed = timeit.timeit(
+            "h.observe(0.005)", globals={"h": h}, number=self.N
+        )
+        assert armed < plain * 60
+
+    def test_record_ingest_bounded(self):
+        """Recorder ingest is a dict/deque shuffle per record — bound
+        it absolutely (generously) so a quadratic tree-assembly bug can
+        never ride in silently."""
+        import timeit
+
+        rec = FlightRecorder(max_trees=16, max_records=256)
+        records = [span_record(s, name="r") for s in range(1, 2001)]
+        wall = timeit.timeit(
+            "for r in records: rec.record(r)",
+            globals={"rec": rec, "records": records},
+            number=5,
+        )
+        assert wall / (5 * len(records)) < 500e-6  # < 500us/record
+
+    def test_no_retained_allocation_armed_or_not(self):
+        h = Histogram("alloc_ex", buckets=(0.001, 0.01, 0.1))
+        h.arm_exemplars()
+        from sdnmpi_tpu.utils import metrics
+
+        metrics.CURRENT_SPAN[0] = 42
+        try:
+            for _ in range(1000):
+                h.observe(0.005)
+            tracemalloc.start()
+            before = tracemalloc.take_snapshot()
+            for _ in range(100_000):
+                h.observe(0.005)
+            after = tracemalloc.take_snapshot()
+            tracemalloc.stop()
+        finally:
+            metrics.CURRENT_SPAN[0] = 0
+        growth = sum(
+            s.size_diff for s in after.compare_to(before, "filename")
+            if s.size_diff > 0
+        )
+        assert growth < 64 * 1024, f"retained {growth} bytes"
+        assert h.exemplars == [0, 42, 0, 0]
+
+
+class TestCongestionGauges:
+    def test_discrete_and_fractional_from_dag_pass(self):
+        """A DAG-balanced batch publishes both congestion figures and
+        their ratio (discrete >= fractional: sampling cannot beat the
+        relaxation it rounds)."""
+        from sdnmpi_tpu.oracle.engine import RouteOracle
+        from sdnmpi_tpu.topogen import fattree
+
+        db = fattree(4).to_topology_db(backend="jax")
+        oracle = RouteOracle()
+        macs = sorted(db.hosts)[:8]
+        pairs = [(a, b) for a in macs for b in macs if a != b]
+        fdbs, maxc = oracle.routes_batch_balanced(
+            db, pairs, link_util={}, dag_threshold=1
+        )
+        assert maxc > 0
+        assert oracle.last_discrete_congestion == maxc
+        assert oracle.last_fractional_congestion > 0
+        assert (
+            maxc >= oracle.last_fractional_congestion - 1e-3
+        )
+        snap = REGISTRY.snapshot()
+        assert snap["gauges"]["congestion_discrete_max"] == maxc
+        assert snap["gauges"]["congestion_fractional_max"] == (
+            oracle.last_fractional_congestion
+        )
+        assert snap["gauges"][
+            "congestion_discrete_over_fractional"
+        ] == pytest.approx(maxc / oracle.last_fractional_congestion)
+
+
+class TestCongestionAnalytics:
+    def _bound_plane(self, db):
+        from sdnmpi_tpu.oracle.engine import tensorize
+        from sdnmpi_tpu.oracle.utilplane import UtilPlane
+
+        plane = UtilPlane()
+        plane.sync(db, tensorize(db))
+        return plane
+
+    def test_hot_links_match_host_topk(self):
+        from sdnmpi_tpu.topogen import fattree
+
+        db = fattree(4).to_topology_db(backend="jax")
+        plane = self._bound_plane(db)
+        rng = np.random.default_rng(5)
+        samples = {}
+        for a in sorted(db.links):
+            for b in sorted(db.links[a]):
+                lk = db.links[a][b]
+                key = (lk.src.dpid, lk.src.port_no)
+                samples[(a, b, key)] = float(rng.random() * 1e9)
+                plane.stage(key, samples[(a, b, key)])
+        plane.flush()
+        hot = plane.hot_links(5)
+        assert len(hot) == 5
+        want = sorted(samples.items(), key=lambda kv: -kv[1])[:5]
+        got = [(h["src"], h["dst"], h["bps"]) for h in hot]
+        for (a, b, key), bps in want:
+            assert (a, b, pytest.approx(bps)) in [
+                (s, d, pytest.approx(v)) for s, d, v in got
+            ] or any(
+                s == a and d == b and abs(v - bps) < 1.0 for s, d, v in got
+            )
+        # descending order, ports decoded
+        assert all(
+            got[i][2] >= got[i + 1][2] for i in range(len(got) - 1)
+        )
+        assert all(h["port"] >= 0 for h in hot)
+
+    def test_topk_zero_recompiles_across_churn_replay(self):
+        """Acceptance: 100 churn steps (cable flaps + fresh samples +
+        a top-k read per step) compile the analytics kernel exactly
+        once — the trace-count probe."""
+        from sdnmpi_tpu.topogen import fattree
+        from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+        db = fattree(4).to_topology_db(backend="jax")
+        plane = self._bound_plane(db)
+        links = [
+            (a, b, db.links[a][b], db.links[b][a])
+            for a in sorted(db.links)
+            for b in sorted(db.links[a])
+            if a < b
+        ]
+        keys = [
+            (lk.src.dpid, lk.src.port_no) for a, b, lk, _ in links
+        ]
+        plane.stage(keys[0], 1e9)
+        plane.flush()
+        plane.hot_links(8)  # warm the kernel
+        TRACE_COUNTS.clear()
+        rng = np.random.default_rng(11)
+        for step in range(100):
+            a, b, fwd, rev = links[int(rng.integers(len(links)))]
+            db.delete_link(fwd)
+            db.delete_link(rev)
+            db.add_link(fwd)
+            db.add_link(rev)
+            assert plane.sync(db)
+            plane.stage(
+                keys[int(rng.integers(len(keys)))],
+                float(rng.random() * 1e9),
+            )
+            plane.flush()
+            assert plane.hot_links(8)
+        assert TRACE_COUNTS["utilplane_topk"] == 0, dict(TRACE_COUNTS)
+
+    def test_stats_flush_report_with_collective_attribution(self):
+        """Full stack: a block-installed collective + hot Monitor
+        samples produce the per-collective attribution report, mirrored
+        into the telemetry snapshot."""
+        from tests.test_collective_blocks import kickoff, make_stack
+
+        fabric, controller, macs = make_stack(dag_flow_threshold=1)
+        kickoff(fabric, macs)  # balanced block install; binds the plane
+        tm = controller.topology_manager
+        assert tm.util_plane is not None and tm.util_plane.bound
+        install = next(iter(controller.router.collectives))
+        assert install.links, "install-time link index missing"
+        # heat exactly one link the collective rides
+        a, b = sorted(install.links)[0]
+        port = tm.topologydb.links[a][b].src.port_no
+        controller.bus.publish(
+            ev.EventPortStats(a, port, 0.0, 0.0, 0.0, 5e9)
+        )
+        controller.bus.publish(ev.EventStatsFlush())
+        report = controller.bus.request(
+            ev.CongestionReportRequest()
+        ).report
+        assert report["top"][0]["bps"] == pytest.approx(5e9)
+        assert report["top"][0]["src"] == a
+        assert report["collectives"], report
+        attributed = report["collectives"][0]
+        assert attributed["cookie"] == install.cookie
+        assert attributed["bps"] == pytest.approx(5e9)
+        snap = controller.telemetry()
+        assert snap["congestion"]["top"][0]["bps"] == pytest.approx(5e9)
+        assert snap["gauges"]["congestion_hot_link_bps"] == pytest.approx(
+            5e9
+        )
+        assert snap["gauges"]["congestion_hot_collectives"] >= 1
+
+
+def test_recorder_process_default_seam():
+    """arm() registers the process-default recorder the bench env hook
+    dumps; the conftest fixture clears it between tests."""
+    from sdnmpi_tpu.utils import flight
+
+    rec = FlightRecorder()
+    rec.arm()
+    try:
+        assert flight.RECORDER is rec
+    finally:
+        rec.disarm()
+
+
+def test_env_dump_hook(tmp_path, monkeypatch):
+    from sdnmpi_tpu.utils import flight
+
+    monkeypatch.delenv(flight.DUMP_ENV, raising=False)
+    assert not flight.install_env_dump_hook()
+    monkeypatch.setenv(flight.DUMP_ENV, str(tmp_path / "f.json"))
+    assert flight.install_env_dump_hook()

@@ -165,14 +165,20 @@ class TestBenchMetricsDump:
 
         seen = {}
 
-        def fake_run_suite(configs, root, only, metrics_dump=False):
+        def fake_run_suite(
+            configs, root, only, metrics_dump=False, flight_dump=False
+        ):
             seen["metrics_dump"] = metrics_dump
+            seen["flight_dump"] = flight_dump
             return []
 
         monkeypatch.setattr(bench_run, "run_suite", fake_run_suite)
-        monkeypatch.setattr(sys, "argv", ["run.py", "--metrics-dump"])
+        monkeypatch.setattr(
+            sys, "argv", ["run.py", "--metrics-dump", "--flight-dump"]
+        )
         try:
             bench_run.main()
         except SystemExit:
             pass
         assert seen["metrics_dump"] is True
+        assert seen["flight_dump"] is True
