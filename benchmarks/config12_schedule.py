@@ -1,0 +1,129 @@
+"""Config 12: collective phase scheduling — modeled completion time and
+achieved-vs-fractional congestion (ISSUE 8, sdnmpi_tpu/sched).
+
+The new bench axis the scheduler opens: not route milliseconds but
+*schedule quality*. On the config-3 workload (512-rank MPI_Alltoall on
+a 3-level fat-tree, k=16) the flat DAG-balanced batch's discrete
+max-link load sits ~1.5x above its own fractional lower bound — the
+scheduling gap named in the ROADMAP. The phase scheduler decomposes the
+collective into K link-load-balanced phases (greedy packing on device,
+phase-grain scanner routing with per-flow load feedback) and its
+*modeled completion* — the sum over phases of each phase's discrete
+max-link load, in flow-per-link rounds — approaches the flat batch's
+fractional bound, which lower-bounds BOTH execution models.
+
+Rows (both CPU-safe at full shape: the device programs are the same
+bucketed kernels the TPU runs, and the quality figures are
+hardware-independent):
+
+- ``sched4_alltoall512_fattree16_completion`` (headline): the scheduled
+  program's modeled completion in max-link flow units. vs_baseline =
+  flat discrete max / scheduled total — how much faster the modeled
+  collective finishes than the single-shot install's bottleneck link
+  (> 1: phasing wins despite serializing the phases).
+- ``sched4_alltoall512_fattree16_vs_fractional``: achieved-vs-bound —
+  scheduled total / the flat batch's fractional bound (the acceptance
+  bar: <= 1.15). vs_baseline = flat ratio / scheduled ratio — the share
+  of the scheduling gap closed.
+
+``schedule_ms`` on the headline row prices the scheduler itself (pack +
+K phase dispatches + reaps) beside ``flat_ms`` for the one-batch route;
+phasing adds pipeline depth, not a serial-latency cliff.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+FATTREE_K = 16
+N_RANKS = 512
+N_PHASES = 0  # auto (K=4 at this shape; see sched.choose_n_phases)
+
+
+def build(k: int = FATTREE_K, n_ranks: int = N_RANKS):
+    """Fat-tree topology DB + the collective's full alltoall pair set
+    (importable at test scale: tests/test_sched.py drives k=8)."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    db = spec.to_topology_db(backend="jax")
+    macs = sorted(m for m, _, _ in spec.hosts)[:n_ranks]
+    n = len(macs)
+    src, dst = np.nonzero(~np.eye(n, dtype=bool))
+    return spec, db, macs, src.astype(np.int32), dst.astype(np.int32)
+
+
+def measure(db, macs, src, dst, n_phases: int = N_PHASES) -> dict:
+    """One flat pass + one scheduled program over the same pairs; the
+    quality figures the two emit rows are built from. The flat pass runs
+    FIRST so its fractional bound (the shared denominator) is captured
+    from the same batch that produced the flat discrete figure."""
+    oracle = db._jax_oracle()
+    t0 = time.perf_counter()
+    oracle.routes_collective(db, macs, src, dst, "balanced")
+    flat_s = time.perf_counter() - t0
+    flat_disc = oracle.last_discrete_congestion
+    frac = oracle.last_fractional_congestion
+    assert frac > 0, "the DAG balancer must report its fractional bound"
+
+    t0 = time.perf_counter()
+    program = oracle.routes_collective_phased(
+        db, macs, src, dst, "balanced", n_phases=n_phases
+    )
+    sched_total = program.total_discrete_congestion()
+    sched_s = time.perf_counter() - t0
+    return {
+        "flat_discrete": float(flat_disc),
+        "fractional": float(frac),
+        "flat_ratio": float(flat_disc / frac),
+        "sched_total": float(sched_total),
+        "sched_ratio": float(sched_total / frac),
+        "max_phase": float(program.max_phase_congestion()),
+        "n_phases": int(program.n_phases),
+        "phase_pairs": [int(p.n_pairs) for p in program.phases],
+        "flat_ms": flat_s * 1e3,
+        "sched_ms": sched_s * 1e3,
+    }
+
+
+def main() -> None:
+    from benchmarks.common import init_backend
+
+    init_backend()
+
+    spec, db, macs, src, dst = build()
+    log(f"fattree k={FATTREE_K}: {spec.n_switches} switches, "
+        f"{len(macs)} ranks, {len(src):,} pairs")
+    m = measure(db, macs, src, dst)  # warm (compiles both legs)
+    m = measure(db, macs, src, dst)
+    log(
+        f"flat: discrete {m['flat_discrete']:,.0f} vs fractional "
+        f"{m['fractional']:,.0f} ({m['flat_ratio']:.3f}x) in "
+        f"{m['flat_ms']:.1f} ms; scheduled K={m['n_phases']}: total "
+        f"{m['sched_total']:,.0f} ({m['sched_ratio']:.3f}x bound, "
+        f"hottest phase {m['max_phase']:,.0f}) in {m['sched_ms']:.1f} ms"
+    )
+    emit(
+        "sched4_alltoall512_fattree16_completion",
+        m["sched_total"], "load",
+        m["flat_discrete"] / max(m["sched_total"], 1.0),
+        fractional_bound=round(m["fractional"], 3),
+        flat_discrete=round(m["flat_discrete"], 3),
+        n_phases=m["n_phases"],
+        flat_ms=round(m["flat_ms"], 3),
+        schedule_ms=round(m["sched_ms"], 3),
+    )
+    emit(
+        "sched4_alltoall512_fattree16_vs_fractional",
+        m["sched_ratio"], "x",
+        m["flat_ratio"] / max(m["sched_ratio"], 1e-9),
+        flat_ratio=round(m["flat_ratio"], 3),
+    )
+
+
+if __name__ == "__main__":
+    main()

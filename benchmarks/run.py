@@ -36,6 +36,7 @@ CONFIGS = [
     ("9", [sys.executable, "-m", "benchmarks.config9_utilplane"]),
     ("10", [sys.executable, "-m", "benchmarks.config10_pipeline"]),
     ("11", [sys.executable, "-m", "benchmarks.config11_recovery"]),
+    ("12", [sys.executable, "-m", "benchmarks.config12_schedule"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

@@ -91,6 +91,18 @@ class RPCInterface:
             ev.EventCollectiveRemoved,
             lambda e: self._broadcast("remove_collective", e.cookie),
         )
+        # phase progress of scheduled installs (ISSUE 8): one summary
+        # per phase boundary — a client watching a large scheduled
+        # collective sees phases land as they hit the wire, ahead of
+        # the program-level install_collective
+        bus.subscribe(
+            ev.EventCollectivePhaseInstalled,
+            lambda e: self._broadcast(
+                "install_collective_phase",
+                e.cookie, e.phase, e.n_phases, e.n_pairs, e.n_flows,
+                e.max_congestion,
+            ),
+        )
         # live telemetry feed: one update_telemetry notification per
         # Monitor pass (EventStatsFlush), carrying the controller's
         # registry snapshot — the same payload api/telemetry.py renders

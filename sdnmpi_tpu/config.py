@@ -117,6 +117,22 @@ class Config:
     #: min/non-min — right for low-diameter topologies like dragonfly)
     #: or "shortest" (deterministic next-hop paths)
     collective_policy: Literal["balanced", "adaptive", "shortest"] = "balanced"
+    #: device-side collective phase scheduler (ISSUE 8,
+    #: sdnmpi_tpu/sched): decompose each block-installed collective
+    #: into K link-load-balanced phases (greedy packing over the
+    #: UtilPlane's per-switch load, jitted) and install the resulting
+    #: phased flow program phase by phase through the pipelined install
+    #: plane with barrier-acked phase boundaries — the scheduled
+    #: program's summed max-link congestion approaches the flat batch's
+    #: fractional bound (~1.11x vs ~1.5x single-shot at the config-3
+    #: shape). Default OFF: the single-shot install path is
+    #: bit-identical to the pre-scheduler controller (pinned by
+    #: differential test).
+    schedule_collectives: bool = False
+    #: requested phase count for scheduled collectives (pow2-rounded up,
+    #: see sched.choose_n_phases); 0 = auto (K=4, K=2 for collectives
+    #: with too few traffic groups to fill 4 phases)
+    schedule_phases: int = 0
     #: UGAL: Valiant intermediate candidates sampled per flow
     ugal_candidates: int = 4
     #: UGAL: detour hysteresis — a detour must beat the minimal DAG cost

@@ -230,6 +230,14 @@ class FindCollectiveRoutesRequest(Request):
     src_idx: Any  # [F] int array
     dst_idx: Any  # [F] int array
     policy: str = "balanced"
+    #: device-side phase scheduler leg (ISSUE 8): not-None routes the
+    #: collective as a *phased flow program* — the pair set packs into
+    #: phases on device (sdnmpi_tpu/sched) and the reply's ``routes``
+    #: is a ``PhasedFlowProgram`` whose per-phase windows are already
+    #: dispatched (reap phase k while k+1..K compute). 0 = auto phase
+    #: count, > 0 = that many (pow2-rounded). None = the flat
+    #: single-shot batch, bit-identical to the pre-scheduler path.
+    schedule: Any = None
 
 
 @dataclasses.dataclass
@@ -374,6 +382,23 @@ class EventCollectiveInstalled(Event):
     n_pairs: int
     n_flows: int  # switch-level flow entries across all blocks
     max_congestion: float
+
+
+@dataclasses.dataclass
+class EventCollectivePhaseInstalled(Event):
+    """One phase of a scheduled collective's phased flow program hit
+    the wire (ISSUE 8) — the phase-boundary event: its install window
+    has been sent (and its barrier xids registered with the recovery
+    plane; the ack drains asynchronously while phase+1 reaps).
+    ``phase`` ascends 0..n_phases-1 in program order; the final phase
+    is followed by the program-level :class:`EventCollectiveInstalled`."""
+
+    cookie: int
+    phase: int
+    n_phases: int
+    n_pairs: int  # rank pairs routed in this phase
+    n_flows: int  # switch-level flow entries this phase installed
+    max_congestion: float  # the phase's discrete max-link load
 
 
 @dataclasses.dataclass

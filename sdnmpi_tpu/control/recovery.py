@@ -94,10 +94,14 @@ class FlowSpec:
     """What one desired flow installs beyond its (src, dst) match: the
     output port and the optional last-hop dl_dst rewrite (MPI virtual ->
     real MAC). Priority/timeouts are uniform per Config, so the store
-    does not repeat them per row."""
+    does not repeat them per row. ``collective`` marks rows installed by
+    the phase scheduler's block plane (ISSUE 8): they reconcile like any
+    other desired row but carry no SwitchFDB bookkeeping — the
+    collective table, not the FDB, owns their lifecycle."""
 
     out_port: int
     rewrite: str | None = None
+    collective: bool = False
 
 
 @dataclasses.dataclass
@@ -129,12 +133,50 @@ class DesiredFlowStore:
 
     def record(
         self, dpid: int, src: str, dst: str, out_port: int,
-        rewrite: str | None = None,
+        rewrite: str | None = None, collective: bool = False,
     ) -> None:
         table = self.flows.setdefault(dpid, {})
-        if (src, dst) not in table:
+        prev = table.get((src, dst))
+        if prev is None:
             self._count += 1
-        table[(src, dst)] = FlowSpec(int(out_port), rewrite)
+        # ownership is first-writer-wins (cleared only by remove): a
+        # re-record of the same match never flips a row between
+        # FDB-owned and collective-owned — a reactive packet-in racing
+        # a phased program's byte-identical row would otherwise hand it
+        # flow timeouts on the next reconcile (and the reverse would
+        # strip the FDB bookkeeping)
+        table[(src, dst)] = FlowSpec(
+            int(out_port), rewrite,
+            collective if prev is None else prev.collective,
+        )
+        _m_desired_flows.set(self._count)
+
+    def record_many(
+        self, dpids, srcs, dsts, out_ports, rewrites,
+        collective: bool = False,
+    ) -> None:
+        """Bulk :meth:`record` over parallel row sequences: one pass,
+        one gauge update. The phase scheduler's install leg records a
+        whole phase's rows (flagship scale: ~1e6 per program) here
+        instead of a scalar call per row."""
+        flows = self.flows
+        fresh = 0
+        for dpid, src, dst, port, rewrite in zip(
+            dpids, srcs, dsts, out_ports, rewrites
+        ):
+            table = flows.setdefault(dpid, {})
+            prev = table.get((src, dst))
+            if prev is None:
+                fresh += 1
+            # first-writer-wins ownership, same rule as record(): a
+            # reactive flow can be byte-identical to a phase row (the
+            # kickoff packet's), and stealing it would strip its
+            # SwitchFDB bookkeeping on the next reconcile
+            table[(src, dst)] = FlowSpec(
+                int(port), rewrite,
+                collective if prev is None else prev.collective,
+            )
+        self._count += fresh
         _m_desired_flows.set(self._count)
 
     def remove(self, dpid: int, src: str, dst: str) -> None:

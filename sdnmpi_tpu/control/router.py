@@ -33,11 +33,56 @@ from sdnmpi_tpu.core.collective_table import CollectiveInstall, CollectiveTable
 from sdnmpi_tpu.core.switch_fdb import SwitchFDB
 from sdnmpi_tpu.protocol import openflow as of
 from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac, is_sdn_mpi_addr
-from sdnmpi_tpu.utils.mac import BROADCAST_MAC, is_ipv6_multicast
+from sdnmpi_tpu.utils.mac import BROADCAST_MAC, int_to_mac, is_ipv6_multicast
 from sdnmpi_tpu.utils.metrics import LATENCY_BUCKETS_S, REGISTRY, SIZE_BUCKETS
 from sdnmpi_tpu.utils.tracing import NULL_SPAN, start_span
 
 log = logging.getLogger("Router")
+
+
+def _mac_of(key: int, memo: dict) -> str:
+    """Memoized ``int_to_mac`` — the ONE MAC-key materialization both
+    the phased install (desired-store rows) and `_mac_rows`
+    (teardown/rollback rows) go through, so the strings can never
+    diverge and break the exact-match delete contract."""
+    s = memo.get(key)
+    if s is None:
+        s = memo[key] = int_to_mac(key)
+    return s
+
+
+def _vmac_luts(
+    coll_type: int, ranks_arr: np.ndarray, macs_str: list,
+) -> tuple:
+    """Member MAC keys + per-endpoint vMAC part LUTs for a collective —
+    the ONE preamble the flat block install and the phased install
+    share, so the encoding call shape can never drift between the legs
+    (their flow tables must stay bit-identical on the differential).
+    The LUTs come from the codec that owns the ABI
+    (vmac = src_lut[si] | dst_lut[di]; the base byte is baked into
+    both, OR-ing it twice is idempotent)."""
+    from sdnmpi_tpu.protocol.vmac import encode_batch_ints
+    from sdnmpi_tpu.utils.mac import macs_to_ints
+
+    zero = np.zeros(len(ranks_arr), np.int64)
+    return (
+        macs_to_ints(macs_str),
+        encode_batch_ints(coll_type, ranks_arr, zero),
+        encode_batch_ints(coll_type, zero, ranks_arr),
+    )
+
+
+def _mac_rows(arr: np.ndarray, memo: dict) -> list:
+    """Materialize a phased install's [N, 3] (dpid, src key, dst key)
+    int rows back into the (dpid, src, dst) MAC-string rows
+    ``_del_flows_window`` tears down by — ``memo`` is shared across
+    phases so each distinct MAC key converts once."""
+    return [
+        (d, _mac_of(s, memo), _mac_of(t, memo))
+        for d, s, t in zip(
+            arr[:, 0].tolist(), arr[:, 1].tolist(), arr[:, 2].tolist()
+        )
+    ]
 
 # -- pipeline telemetry (ISSUE 4): every stage of the route->install
 # pipeline records into the process-wide registry; the RPC mirror and
@@ -141,6 +186,32 @@ _m_reval_affected = REGISTRY.histogram(
 _m_recovery_redrive_s = REGISTRY.histogram(
     "recovery_redrive_seconds", LATENCY_BUCKETS_S,
     "wall of one recovery re-drive (retry-queue pop: deletes + resync)",
+)
+# collective phase scheduler (ISSUE 8): phase progress of scheduled
+# installs — the telemetry snapshot (and its RPC mirror) carries these
+# beside the per-phase EventCollectivePhaseInstalled broadcasts.
+_m_sched_programs = REGISTRY.counter(
+    "sched_programs_total", "phased flow programs installed"
+)
+_m_sched_phases = REGISTRY.counter(
+    "sched_phases_total", "collective phases installed (all programs)"
+)
+_m_sched_phase_install_s = REGISTRY.histogram(
+    "sched_phase_install_seconds", LATENCY_BUCKETS_S,
+    "one phase's reap + FlowMod materialization + batched install "
+    "(phases k+1..K compute on device while this runs)",
+)
+_m_sched_completion = REGISTRY.gauge(
+    "sched_program_completion",
+    "modeled completion of the last scheduled program: sum over phases "
+    "of the phase's discrete max-link load (phases serialize; the "
+    "bottleneck link bounds each phase's duration) — the live twin of "
+    "bench config 12's completion figure",
+)
+_m_sched_max_phase = REGISTRY.gauge(
+    "sched_program_max_phase_congestion",
+    "hottest single phase of the last scheduled program — the figure "
+    "comparable to a flat install's max_congestion",
 )
 
 
@@ -927,7 +998,6 @@ class Router:
         sdnmpi/router.py:125-160, sdnmpi/util/topology_db.py:59-84)."""
 
         from sdnmpi_tpu import native
-        from sdnmpi_tpu.utils.mac import macs_to_ints
 
         signature = (coll_type, root_rank, tuple(ranks))
         if self.collectives.get_by_signature(signature) is not None:
@@ -960,22 +1030,34 @@ class Router:
         src_idx = src_idx.astype(np.int32)
         dst_idx = dst_idx.astype(np.int32)
 
+        # phase-scheduler leg (ISSUE 8): with Config.schedule_collectives
+        # the request carries schedule= (0 = auto phase count) and the
+        # reply's routes is a PhasedFlowProgram whose per-phase device
+        # programs are already dispatched; everything below then runs
+        # per phase in _install_collective_phased. Default off: the
+        # request is bit-identical to the pre-scheduler controller.
+        schedule = (
+            int(self.config.schedule_phases)
+            if self.config.schedule_collectives else None
+        )
         routes = self.bus.request(
             ev.FindCollectiveRoutesRequest(
-                macs_str, src_idx, dst_idx, policy=policy
+                macs_str, src_idx, dst_idx, policy=policy,
+                schedule=schedule,
             )
         ).routes
+        if schedule is not None:
+            return self._install_collective_phased(
+                coll_type, ranks, root_rank, policy, macs_str,
+                src_idx, dst_idx, routes,
+            )
 
         # member-key production + counting sort by sub-flow, one native
-        # pass. The per-endpoint vMAC part LUTs come from the codec that
-        # owns the ABI (vmac = src_lut[si] | dst_lut[di]; the base byte
-        # is baked into both, OR-ing it twice is idempotent)
-        from sdnmpi_tpu.protocol.vmac import encode_batch_ints
-
-        mac_keys = macs_to_ints(macs_str)
-        zero = np.zeros(len(ranks_arr), np.int64)
-        vmac_src_lut = encode_batch_ints(coll_type, ranks_arr, zero)
-        vmac_dst_lut = encode_batch_ints(coll_type, zero, ranks_arr)
+        # pass; MAC keys + vMAC part LUTs through the preamble shared
+        # with the phased leg (_vmac_luts owns the ABI comment)
+        mac_keys, vmac_src_lut, vmac_dst_lut = _vmac_luts(
+            coll_type, ranks_arr, macs_str
+        )
         bounds, m_src, m_vmac, m_rew, m_fport = native.scatter_members(
             routes.pair_sub, src_idx, dst_idx, mac_keys,
             vmac_src_lut, vmac_dst_lut, mac_keys, routes.endpoint_port,
@@ -1046,8 +1128,349 @@ class Router:
             routes.max_congestion,
         )
 
+    def _install_collective_phased(
+        self,
+        coll_type: int,
+        ranks: list[int],
+        root_rank,
+        policy: str,
+        macs_str: list[str],
+        src_idx: np.ndarray,
+        dst_idx: np.ndarray,
+        program,
+    ) -> None:
+        """Install a scheduled collective's phased flow program
+        (ISSUE 8), phase by phase through the PR-3 window plane.
+
+        Every phase's device program was dispatched back to back by the
+        oracle before this method sees the program, so reaping phase k
+        here overlaps phases k+1..K's device compute — phasing adds
+        pipeline depth, not serial latency. Each phase's reaped
+        :class:`CollectiveRoutes` materializes into member-level FlowMod
+        rows with array ops (one ``np.repeat`` cascade over the member
+        scatter — no per-pair Python in the hop math), ships as ONE
+        batched window per phase, and registers its barrier xids with
+        the recovery plane: the barrier acks ARE the phase boundary,
+        draining asynchronously while the next phase reaps. Desired
+        rows are recorded per switch with ``collective=True`` (the
+        collective table owns their lifecycle, not the SwitchFDB), so a
+        switch that crashes and redials MID-PROGRAM reconciles to
+        exactly the phases installed so far. Per-phase rows and the
+        per-phase directed-link index land in the
+        :class:`CollectiveInstall` (teardown re-drives the rows;
+        congestion attribution resolves a hot link to the phase riding
+        it)."""
+        from sdnmpi_tpu import native
+
+        ranks_arr = np.asarray(ranks, dtype=np.int64)
+        mac_keys, vmac_src_lut, vmac_dst_lut = _vmac_luts(
+            coll_type, ranks_arr, macs_str
+        )
+        dps = np.fromiter(self.dps, np.int64, len(self.dps))
+        dps.sort()
+        dps_set = set(dps.tolist())
+
+        cookie = self.collectives.next_cookie()
+        total_flows = 0
+        switches: set[int] = set()
+        links_all: set[tuple[int, int]] = set()
+        phase_links: dict[tuple[int, int], list[int]] = {}
+        # per-phase rows are ONE [N, 3] (dpid, src key, dst key) int
+        # array, not N string tuples: a flagship-scale program retains
+        # millions of rows for the install's lifetime so teardown can
+        # re-derive exact matches, and the key arrays cost ~10x less —
+        # MAC strings re-materialize in one memoized pass (_mac_rows)
+        # at teardown/rollback
+        phase_rows: list[tuple[int, np.ndarray]] = []
+        phase_cong: list[float] = []
+        # the current phase's shipped rows: a phase that fails
+        # mid-program (device reap error, raising send) must not orphan
+        # rows already on the switches and in the desired store with no
+        # CollectiveInstall recorded to ever tear them down — the
+        # rollback set is phase_rows plus this array
+        arr_rows = np.empty((0, 3), np.int64)
+        mac_memo: dict[int, str] = {}
+
+        sp = start_span(
+            "collective_program", cookie=cookie,
+            n_phases=program.n_phases, n_pairs=program.n_pairs,
+        )
+        try:
+            for plan in program.phases:
+                t0 = time.perf_counter()
+                psp = sp.child(
+                    "collective_phase", phase=plan.phase,
+                    n_pairs=plan.n_pairs,
+                )
+                try:
+                    routes = plan.reap()
+                    bounds, m_src, m_vmac, m_rew, m_fport = (
+                        native.scatter_members(
+                            routes.pair_sub,
+                            src_idx[plan.pair_idx], dst_idx[plan.pair_idx],
+                            mac_keys, vmac_src_lut, vmac_dst_lut, mac_keys,
+                            routes.endpoint_port, 0, routes.n_subflows,
+                        )
+                    )
+                    hop_dpid = np.asarray(routes.hop_dpid)
+                    hop_port = np.asarray(routes.hop_port)
+                    hop_len = np.asarray(routes.hop_len)
+                    # member -> flat (member, hop) rows, all array ops:
+                    # every member of sub-flow s contributes hop_len[s]
+                    # rows riding s's shared transit path, with the
+                    # member's own final port / rewrite on the last hop
+                    m_sub = np.repeat(
+                        np.arange(routes.n_subflows), np.diff(bounds)
+                    )
+                    rep = hop_len[m_sub]  # [M] rows per member
+                    n_phase_flows = int(rep.sum())
+                    if n_phase_flows == 0:
+                        continue  # no routable member in this phase
+                    row_m = np.repeat(np.arange(len(m_sub)), rep)
+                    starts = np.zeros(len(m_sub), np.int64)
+                    np.cumsum(rep[:-1], out=starts[1:])
+                    hop_pos = np.arange(len(row_m)) - starts[row_m]
+                    sub_r = m_sub[row_m]
+                    r_dpid = hop_dpid[sub_r, hop_pos]
+                    last = hop_pos == hop_len[sub_r] - 1
+                    r_port = np.where(
+                        last, m_fport[row_m], hop_port[sub_r, hop_pos]
+                    ).astype(np.int32)
+                    r_src = m_src[row_m]
+                    r_dst = m_vmac[row_m]
+                    r_rew = np.where(last, m_rew[row_m], -1)
+
+                    live = np.isin(r_dpid, dps)
+                    scalar = (
+                        not self.config.pipelined_install
+                        or not hasattr(self.southbound, "flow_mods_batch")
+                    )
+                    # every figure downstream — the flows metric, the
+                    # phase/program events, CollectiveInstall.n_flows —
+                    # counts LIVE rows only, consistent with the rows
+                    # that actually ship, enter the desired store, and
+                    # land in phase_rows for teardown/reconcile
+                    n_live = int(live.sum())
+                    failed: set[int] = set()
+                    arr_rows = np.stack(
+                        [
+                            r_dpid[live].astype(np.int64),
+                            r_src[live].astype(np.int64),
+                            r_dst[live].astype(np.int64),
+                        ],
+                        axis=1,
+                    )
+                    # one bulk pass over the live rows — C-level int
+                    # conversion (tolist), memoized MAC strings, ONE
+                    # desired-store transaction — instead of a Python
+                    # record() call per (member, hop) row
+                    l_dpid = arr_rows[:, 0].tolist()
+                    l_src = [
+                        _mac_of(k, mac_memo)
+                        for k in arr_rows[:, 1].tolist()
+                    ]
+                    l_dst = [
+                        _mac_of(k, mac_memo)
+                        for k in arr_rows[:, 2].tolist()
+                    ]
+                    l_port = r_port[live].tolist()
+                    l_rew = [
+                        _mac_of(k, mac_memo) if k >= 0 else None
+                        for k in r_rew[live].tolist()
+                    ]
+                    self.recovery.desired.record_many(
+                        l_dpid, l_src, l_dst, l_port, l_rew,
+                        collective=True,
+                    )
+                    if scalar:
+                        # the pipelined_install=False differential
+                        # escape hatch (and batchless southbounds): one
+                        # scalar FlowMod per row, permanent —
+                        # byte-identical to the batched leg's rows
+                        for d, src, dst, port, rewrite in zip(
+                            l_dpid, l_src, l_dst, l_port, l_rew
+                        ):
+                            actions: tuple = (of.ActionOutput(port),)
+                            if rewrite:
+                                actions = (
+                                    of.ActionSetDlDst(rewrite),
+                                ) + actions
+                            sent = self.southbound.flow_mod(d, of.FlowMod(
+                                match=of.Match(dl_src=src, dl_dst=dst),
+                                actions=actions,
+                                priority=self.config.priority_default,
+                            ))
+                            if sent is False:
+                                failed.add(d)
+                    if n_live:
+                        _m_flows_installed.inc(n_live)
+                        if scalar:
+                            verdict = (
+                                InstallVerdict(dropped=sorted(failed))
+                                if failed else None
+                            )
+                        else:
+                            kd = r_dpid[live]
+                            order = np.argsort(kd, kind="stable")
+                            # no cookie on the wire: phased teardown and
+                            # reconcile re-drive by exact (src, dst)
+                            # match rows (phase_rows / desired store),
+                            # and a recovery re-drive could not carry a
+                            # cookie — rows stay byte-identical across
+                            # fresh install, re-drive, and escape-hatch
+                            # legs by carrying none anywhere
+                            burst = of.FlowModBatch(
+                                src=r_src[live][order],
+                                dst=r_dst[live][order],
+                                out_port=r_port[live][order],
+                                rewrite=r_rew[live][order],
+                                priority=self.config.priority_default,
+                            )
+                            verdict = self._send_window(kd[order], burst)
+                        if self.config.recovery_plane:
+                            # the phase boundary: its barrier xids arm
+                            # the pending-ack table and drain while the
+                            # next phase reaps (dropped scalar rows
+                            # enter the same bounded retry queue)
+                            self.recovery.note_send(verdict)
+
+                    # reval index: the FULL ridden set, including
+                    # switches whose rows were dead at install time —
+                    # a later flap/redial of such a switch is exactly
+                    # the delta that must re-route (and heal) this
+                    # program, so it updates even for a phase that
+                    # shipped NOTHING (all dpids dead needs the healing
+                    # index most)
+                    ridden_sw = hop_dpid[hop_dpid >= 0]
+                    switches.update(
+                        int(d) for d in np.unique(ridden_sw)
+                    )
+                    if not n_live:
+                        # nothing shipped (every routed dpid left
+                        # self.dps): no rows, no attribution, no phase
+                        # event — the same rule as a phase with no
+                        # routable member above
+                        continue
+                    total_flows += n_live
+                    a, b = hop_dpid[:, :-1], hop_dpid[:, 1:]
+                    ridden = (a >= 0) & (b >= 0)
+                    # attribution index: only links a LIVE switch
+                    # transmits on — a dead switch's rows never
+                    # shipped, so no phase traffic leaves it, and the
+                    # congestion report must not resolve a hot link to
+                    # a phase with zero flows on it
+                    links_p = {
+                        lk
+                        for lk in zip(
+                            a[ridden].astype(int).tolist(),
+                            b[ridden].astype(int).tolist(),
+                        )
+                        if lk[0] in dps_set
+                    }
+                    links_all.update(links_p)
+                    for link in links_p:
+                        phase_links.setdefault(link, []).append(plan.phase)
+                    phase_rows.append((plan.phase, arr_rows))
+                    arr_rows = np.empty((0, 3), np.int64)
+                    phase_cong.append(float(routes.max_congestion))
+                    _m_sched_phases.inc()
+                    _m_sched_phase_install_s.observe(
+                        time.perf_counter() - t0
+                    )
+                    self.bus.publish(
+                        ev.EventCollectivePhaseInstalled(
+                            cookie, plan.phase, program.n_phases,
+                            plan.n_pairs, n_live,
+                            float(routes.max_congestion),
+                        )
+                    )
+                finally:
+                    psp.end()
+        except BaseException:
+            # roll the partial program back: tear down every row already
+            # shipped (they leave the desired store inside) so the
+            # failure leaves no permanent flows that reconcile would
+            # re-drive forever. Later phases' still-in-flight device
+            # programs are simply abandoned — nothing of theirs reached
+            # a switch.
+            rollback = [
+                row
+                for _, arr in phase_rows
+                for row in _mac_rows(arr, mac_memo)
+            ]
+            rollback.extend(_mac_rows(arr_rows, mac_memo))
+            rollback = self._program_owned_rows(rollback)
+            if rollback:
+                self._del_flows_window(rollback)
+            raise
+        finally:
+            sp.end(n_flows=total_flows)
+        if total_flows == 0:
+            return  # nothing routable: don't record an empty install
+
+        max_phase = max(phase_cong, default=0.0)
+        _m_sched_programs.inc()
+        _m_sched_completion.set(float(sum(phase_cong)))
+        _m_sched_max_phase.set(max_phase)
+        self.collectives.add(
+            CollectiveInstall(
+                cookie, coll_type, tuple(ranks), root_rank,
+                policy, macs_str, src_idx, dst_idx,
+                n_pairs=len(src_idx), n_flows=total_flows,
+                max_congestion=max_phase,
+                switches=frozenset(switches),
+                links=frozenset(links_all),
+                n_phases=program.n_phases,
+                phase_links={
+                    link: tuple(sorted(set(ps)))
+                    for link, ps in phase_links.items()
+                },
+                phase_rows=phase_rows,
+            )
+        )
+        self.bus.publish(
+            ev.EventCollectiveInstalled(
+                cookie, coll_type, len(src_idx), total_flows, max_phase,
+            )
+        )
+        log.info(
+            "phased block install: collective %s, %d pairs, %d phases, "
+            "%d switch flows, completion %s (max phase %s)",
+            coll_type, len(src_idx), len(phase_rows), total_flows,
+            sum(phase_cong), max_phase,
+        )
+
+    def _program_owned_rows(self, rows) -> list:
+        """Filter a phased teardown/rollback burst down to the rows the
+        program actually OWNS in the desired store: a reactive flow
+        byte-identical to a phase row stays FDB-owned under the store's
+        first-writer-wins rule, and deleting it here would yank a live
+        FDB flow out from under its bookkeeping. Rows already gone from
+        the store still delete (switch-side cleanup)."""
+        desired = self.recovery.desired.flows
+        out = []
+        for d, s, t in rows:
+            spec = desired.get(d, {}).get((s, t))
+            if spec is None or spec.collective:
+                out.append((d, s, t))
+        return out
+
     def _remove_collective(self, install: CollectiveInstall) -> None:
-        self.southbound.flow_blocks_delete(install.cookie)
+        if install.n_phases and install.phase_rows is not None:
+            # scheduled installs went through the window plane, not the
+            # block plane: no cookie-recorded block entries exist — tear
+            # down by the exact per-phase rows (one batched OFPFC_DELETE
+            # window; the rows leave the desired store inside)
+            memo: dict[int, str] = {}
+            self._del_flows_window(
+                self._program_owned_rows(
+                    row
+                    for _, arr in install.phase_rows
+                    for row in _mac_rows(arr, memo)
+                )
+            )
+        else:
+            self.southbound.flow_blocks_delete(install.cookie)
         self.collectives.remove(install.cookie)
         self.bus.publish(ev.EventCollectiveRemoved(install.cookie))
 
@@ -1147,8 +1570,14 @@ class Router:
             if not rows:
                 return
             # the down-edge cleared this switch's FDB rows; restore the
-            # bookkeeping the installs below re-create on the switch
+            # bookkeeping the installs below re-create on the switch.
+            # Rows installed by the phase scheduler's window plane
+            # (spec.collective) re-drive like any other desired row but
+            # carry NO SwitchFDB bookkeeping — the collective table
+            # owns their lifecycle (ISSUE 8).
             for src, dst, spec in rows:
+                if spec.collective:
+                    continue
                 if not self.fdb.exists(dpid, src, dst):
                     self.fdb.update(dpid, src, dst, spec.out_port)
                     self.bus.publish(
@@ -1205,7 +1634,19 @@ class Router:
                 actions = (
                     (of.ActionSetDlDst(spec.rewrite),) if spec.rewrite else ()
                 )
-                sent = self._add_flow(dpid, src, dst, spec.out_port, actions)
+                if spec.collective:
+                    # phase-scheduler rows re-drive PERMANENT (their
+                    # fresh install carries no timeouts), same as the
+                    # batched leg's collective split below
+                    sent = self.southbound.flow_mod(dpid, of.FlowMod(
+                        match=of.Match(dl_src=src, dl_dst=dst),
+                        actions=actions + (of.ActionOutput(spec.out_port),),
+                        priority=self.config.priority_default,
+                    ))
+                else:
+                    sent = self._add_flow(
+                        dpid, src, dst, spec.out_port, actions
+                    )
                 ok = ok and sent is not False
             return InstallVerdict(
                 sent=[dpid] if ok else [], dropped=[] if ok else [dpid]
@@ -1213,21 +1654,52 @@ class Router:
 
         from sdnmpi_tpu.utils.mac import mac_to_int, macs_to_ints
 
-        burst = of.FlowModBatch(
-            src=macs_to_ints([r[0] for r in rows]),
-            dst=macs_to_ints([r[1] for r in rows]),
-            out_port=np.array([r[2].out_port for r in rows], np.int32),
-            rewrite=np.array(
-                [mac_to_int(r[2].rewrite) if r[2].rewrite else -1
-                 for r in rows],
-                np.int64,
-            ),
-            priority=self.config.priority_default,
-            idle_timeout=self.config.flow_idle_timeout,
-            hard_timeout=self.config.flow_hard_timeout,
-        )
-        _m_flows_installed.inc(len(rows))
-        return self._send_window(np.full(len(rows), dpid, np.int64), burst)
+        # collective rows (the phase scheduler's window plane) installed
+        # permanent — splitting the burst keeps the re-drive
+        # byte-identical to each row's fresh install when the config
+        # carries flow timeouts
+        verdict: InstallVerdict | None = None
+        for collective in (False, True):
+            part = [r for r in rows if r[2].collective is collective]
+            if not part:
+                continue
+            burst = of.FlowModBatch(
+                src=macs_to_ints([r[0] for r in part]),
+                dst=macs_to_ints([r[1] for r in part]),
+                out_port=np.array([r[2].out_port for r in part], np.int32),
+                rewrite=np.array(
+                    [mac_to_int(r[2].rewrite) if r[2].rewrite else -1
+                     for r in part],
+                    np.int64,
+                ),
+                priority=self.config.priority_default,
+                idle_timeout=(
+                    0 if collective else self.config.flow_idle_timeout
+                ),
+                hard_timeout=(
+                    0 if collective else self.config.flow_hard_timeout
+                ),
+            )
+            _m_flows_installed.inc(len(part))
+            v = self._send_window(np.full(len(part), dpid, np.int64), burst)
+            if isinstance(v, InstallVerdict):
+                if verdict is None:
+                    verdict = InstallVerdict()
+                verdict.sent += v.sent
+                verdict.dropped += v.dropped
+                verdict.barriers += v.barriers
+            elif verdict is None:
+                verdict = v
+        if isinstance(verdict, InstallVerdict):
+            # restore the InstallVerdict contract across the split: the
+            # dpid appears in exactly ONE of sent/dropped, once — both
+            # parts failing must not list it twice (note_send would
+            # burn two retry attempts per actual failure), and a
+            # half-failed split needs the retry (dropped wins)
+            dropped = set(verdict.dropped)
+            verdict.dropped = sorted(dropped)
+            verdict.sent = sorted(set(verdict.sent) - dropped)
+        return verdict
 
     def recovery_tick(self, now: float | None = None) -> None:
         """One anti-entropy pass (per EventStatsFlush — the Monitor's

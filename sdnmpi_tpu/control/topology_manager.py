@@ -268,6 +268,18 @@ class TopologyManager:
         if req.policy == "adaptive":
             kwargs["ugal_candidates"] = cfg.ugal_candidates
             kwargs["ugal_bias"] = cfg.ugal_bias
+        if req.schedule is not None:
+            # phase-scheduler leg (ISSUE 8): the reply's routes is a
+            # PhasedFlowProgram with every phase's device program
+            # already dispatched — the Router reaps and installs phase
+            # k while phases k+1..K compute
+            return ev.FindCollectiveRoutesReply(
+                self.topologydb.find_routes_collective_phased(
+                    req.macs, req.src_idx, req.dst_idx,
+                    policy=req.policy, n_phases=int(req.schedule),
+                    **kwargs,
+                )
+            )
         routes = self.topologydb.find_routes_collective(
             req.macs, req.src_idx, req.dst_idx, policy=req.policy, **kwargs
         )
@@ -440,13 +452,24 @@ class TopologyManager:
                     continue
                 ride = [k for k in hot_keys if k in install.links]
                 if ride:
-                    colls.append({
+                    entry = {
                         "cookie": install.cookie,
                         "coll_type": install.coll_type,
                         "n_pairs": install.n_pairs,
                         "hot_links": len(ride),
                         "bps": sum(hot_keys[k] for k in ride),
-                    })
+                    }
+                    # phase-grain attribution (ISSUE 8): a scheduled
+                    # install resolves the hot link not just to the
+                    # collective but to the PHASE(S) riding it
+                    if install.phase_links is not None:
+                        phases = sorted({
+                            p for k in ride
+                            for p in install.phase_links.get(k, ())
+                        })
+                        entry["n_phases"] = install.n_phases
+                        entry["phases"] = phases
+                    colls.append(entry)
             colls.sort(key=lambda c: -c["bps"])
         _m_hot_collectives.set(len(colls))
         oracle = getattr(self.topologydb, "_oracle", None)

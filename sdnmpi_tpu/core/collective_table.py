@@ -42,6 +42,22 @@ class CollectiveInstall:
     #: link's load is attributed to exactly the collectives whose
     #: installed blocks traverse it. Empty = unknown.
     links: frozenset = frozenset()
+    #: phase count of a scheduled install's phased flow program
+    #: (ISSUE 8); 0 = flat single-shot install
+    n_phases: int = 0
+    #: directed link -> sorted tuple of phase ids whose routed blocks
+    #: ride it — the phase-grain attribution index (ISSUE 8): a hot
+    #: link resolves not just to the collective but to the PHASE(S)
+    #: riding it. None for flat installs.
+    phase_links: "object" = None
+    #: [(phase id, [N, 3] int array of (dpid, src key, dst key)), ...]
+    #: — the exact switch rows each installed phase put on the wire
+    #: (install order), kept as MAC-key arrays (a flagship program holds
+    #: millions of rows; string tuples would cost ~10x the memory). The
+    #: MAC strings re-materialize at teardown (router._mac_rows); the
+    #: chaos tests assert installed == desired against them per phase.
+    #: None for flat installs.
+    phase_rows: "object" = None
 
     @property
     def signature(self) -> tuple:

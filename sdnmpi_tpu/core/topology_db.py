@@ -526,6 +526,84 @@ class TopologyDB:
             endpoint_port=endpoint_port,
         )
 
+    def find_routes_collective_phased(
+        self,
+        macs: list,
+        src_idx,
+        dst_idx,
+        policy: str = "balanced",
+        n_phases: int = 0,
+        **kwargs,
+    ):
+        """Phase-scheduled whole-collective routing (ISSUE 8): the pair
+        set is decomposed into K link-load-balanced phases and each
+        phase routed as its own batch; returns a
+        :class:`~sdnmpi_tpu.sched.program.PhasedFlowProgram` whose
+        phases the Router installs in order with barrier-acked
+        boundaries. On the JAX backend the packing runs on device
+        (sdnmpi_tpu/sched); the pure-Python backend runs the packer's
+        bit-exact host twin over the same grouping and routes each
+        phase through the scalar oracle — the differential twin of the
+        whole program shape."""
+        if self.backend == "jax":
+            return self._jax_oracle().routes_collective_phased_dispatch(
+                self, macs, src_idx, dst_idx, policy, n_phases=n_phases,
+                **kwargs,
+            )
+        import numpy as np
+
+        from sdnmpi_tpu.oracle.batch import RouteWindow
+        from sdnmpi_tpu.sched import choose_n_phases, pack_phases
+        from sdnmpi_tpu.sched.program import PhasedFlowProgram, PhasePlan
+
+        src_idx = np.ascontiguousarray(src_idx, dtype=np.int32)
+        dst_idx = np.ascontiguousarray(dst_idx, dtype=np.int32)
+        f = len(src_idx)
+        # compact switch index over sorted dpids (the tensor path's row
+        # order), so host and device packers see identical group ids
+        dpids = sorted(self.switches)
+        index = {d: i for i, d in enumerate(dpids)}
+        v = max(1, len(dpids))
+        edge = np.full(len(macs), -1, np.int32)
+        for i, mac in enumerate(macs):
+            resolved = self._resolve_endpoint(mac)
+            if resolved is not None and resolved[0] in index:
+                edge[i] = index[resolved[0]]
+        src_sw = edge[src_idx]
+        dst_sw = edge[dst_idx]
+        ok = (src_sw >= 0) & (dst_sw >= 0)
+        pair_phase = np.full(f, -1, np.int32)
+        k = choose_n_phases(0, n_phases)
+        if ok.any():
+            # the SHARED group-build (sched.aggregate_groups): key
+            # encoding, dense-space bincount, and same-switch
+            # zero-weighting identical to the device path by
+            # construction. The py backend has no utilization plane, so
+            # the background terms are idle (zeros); on an idle/uniform
+            # fabric this matches the device packer bit-for-bit (a
+            # uniform constant commutes out of the bottleneck max).
+            from sdnmpi_tpu.sched.phases import aggregate_groups
+
+            _, uniq, inv, counts, g_src, g_dst, w = aggregate_groups(
+                src_sw[ok], dst_sw[ok], v
+            )
+            k = choose_n_phases(len(uniq), n_phases)
+            # pack_phases owns the heaviest-first ordering contract on
+            # both backends — the jax/py pair->phase bit-identity must
+            # not depend on a second copy of it here
+            packed = pack_phases(g_src, g_dst, w, k, v, device=False)
+            pair_phase[ok] = packed[inv]
+        phases = []
+        for p in range(k):
+            sel = np.nonzero(pair_phase == p)[0]
+            if not len(sel):
+                continue
+            routes = self.find_routes_collective(
+                macs, src_idx[sel], dst_idx[sel], policy, **kwargs
+            )
+            phases.append(PhasePlan(p, sel, RouteWindow(result=routes)))
+        return PhasedFlowProgram(k, pair_phase, phases)
+
     # -- backend dispatch ------------------------------------------------
 
     def _shortest_route(self, src_dpid: int, dst_dpid: int) -> list[int]:

@@ -125,6 +125,9 @@ def config_from_args(args) -> Config:
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
+        schedule_collectives=getattr(args, "schedule_phases", None)
+        is not None,
+        schedule_phases=getattr(args, "schedule_phases", None) or 0,
         delta_reval=not getattr(args, "no_delta_reval", False),
         install_barriers=not getattr(args, "no_install_barriers", False),
         install_retry_max=getattr(args, "install_retry_max", 4),
@@ -304,6 +307,15 @@ async def amain(args) -> None:
             task.cancel()
 
 
+def _nonneg_int(s: str) -> int:
+    v = int(s)
+    if v < 0:
+        raise argparse.ArgumentTypeError(
+            f"must be >= 0, got {v} (0 = auto)"
+        )
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="sdnmpi_tpu", description="TPU-native SDN-MPI controller"
@@ -367,6 +379,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the failure-domain recovery plane (desired-flow "
         "reconciliation, install retries, anti-entropy) — restores the "
         "fire-and-forget legacy for differential runs",
+    )
+    parser.add_argument(
+        "--schedule-phases", type=_nonneg_int, default=None, metavar="K",
+        help="enable the device-side collective phase scheduler "
+        "(sdnmpi_tpu/sched): block-installed collectives decompose into "
+        "K link-load-balanced phases installed with barrier-acked "
+        "boundaries (K is pow2-rounded and clamped at 32; 0 = auto). "
+        "Omit the flag for the bit-identical single-shot install path",
     )
     parser.add_argument(
         "--no-delta-reval", action="store_true",

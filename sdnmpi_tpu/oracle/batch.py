@@ -30,8 +30,9 @@ def bucket_pow2(n: int, floor: int = 8) -> int:
     event. The delta-narrowed churn path uses this: every link flap
     dirties a different number of flows, and multiple-of-8 buckets
     would compile a fresh trace almost per flap, while pow2 buckets
-    bound the cache at log2(F) entries for the whole storm."""
-    out = max(8, floor)
+    bound the cache at log2(F) entries for the whole storm. A smaller
+    ``floor`` is honored (the phase-count ladder rounds from 1)."""
+    out = max(1, floor)
     while out < n:
         out *= 2
     return out

@@ -1224,12 +1224,31 @@ class RouteOracle:
             slots, self._order, src_idx, dst_idx, complete=True
         )
 
-    def _note_congestion(self, discrete: float, dag: bool) -> None:
+    def _note_congestion(
+        self, discrete: float, dag: bool, phase: bool = False
+    ) -> None:
         """Record a just-reaped balanced pass's discrete max-congestion
         beside the DAG balancer's fractional bound and publish the
         ratio gauge (only when the DAG engine balanced THIS batch —
         the greedy scanner and shortest/adaptive paths have no
-        fractional relaxation to compare against)."""
+        fractional relaxation to compare against). A non-DAG pass
+        CLEARS the fractional/ratio pair instead of leaving it behind
+        (ISSUE 8): the gauges describe the LAST pass, and a policy
+        switch (balanced -> shortest) used to keep surfacing the stale
+        DAG gap in anomaly bundles and congestion reports beside a
+        discrete figure it was never computed against.
+
+        ``phase`` marks a scheduled program's per-phase sub-batch (the
+        phase-grain scanner leg, ISSUE 8): it records NOTHING here.
+        The scanner computes no fractional relaxation, so updating even
+        the discrete figure would leave the congestion report pairing a
+        phase's max with the last flat pass's bound and ratio — exactly
+        the cross-batch triple this method exists to prevent — and
+        clearing would wipe a live flat figure mid-program. The
+        program-level quality figures live in the sched_program_*
+        gauges (control/router.py)."""
+        if phase:
+            return
         self.last_discrete_congestion = float(discrete)
         _m_disc_congestion.set(self.last_discrete_congestion)
         if dag and discrete > 0 and self.last_fractional_congestion > 0:
@@ -1237,6 +1256,11 @@ class RouteOracle:
                 discrete / self.last_fractional_congestion
             )
             _m_congestion_ratio.set(self.last_congestion_ratio)
+        elif not dag:
+            self.last_fractional_congestion = 0.0
+            self.last_congestion_ratio = 0.0
+            _m_frac_congestion.set(0.0)
+            _m_congestion_ratio.set(0.0)
 
     def _pad_flows(self, src_idx, dst_idx, weight=None):
         """End-pad a flow batch to the mesh shard count: -1 endpoints
@@ -1551,7 +1575,15 @@ class RouteOracle:
     ):
         """Blocking twin of :meth:`routes_collective_dispatch` —
         dispatch and reap back to back; returns the collective's
-        :class:`~sdnmpi_tpu.oracle.batch.CollectiveRoutes`."""
+        :class:`~sdnmpi_tpu.oracle.batch.CollectiveRoutes` (or, with
+        ``schedule=``, the fully-reaped
+        :class:`~sdnmpi_tpu.sched.program.PhasedFlowProgram`)."""
+        if kwargs.get("schedule") is not None:
+            program = self.routes_collective_dispatch(
+                db, macs, src_idx, dst_idx, policy, **kwargs
+            )
+            program.reap_all()
+            return program
         return self.routes_collective_dispatch(
             db, macs, src_idx, dst_idx, policy, **kwargs
         ).reap()
@@ -1571,6 +1603,9 @@ class RouteOracle:
         rounds: int = 2,
         ugal_candidates: int = 4,
         ugal_bias: float = 1.0,
+        schedule: Optional[int] = None,
+        _phase_scan: Optional[int] = None,
+        _phase: bool = False,
     ):
         """Route an entire collective given in compressed array form,
         split-phase: the device program is launched here (JAX async
@@ -1594,11 +1629,29 @@ class RouteOracle:
         This replaces the reference's per-pair DFS-per-packet-in contract
         (reference: sdnmpi/util/topology_db.py:59-84 x 16.7M calls) with
         one resolve + one device program + one decode.
+
+        ``schedule`` (ISSUE 8) is the phase-scheduler leg: not-None
+        routes the collective as a *phased flow program* instead of one
+        flat batch — the pair set is packed into phases on device
+        (sdnmpi_tpu/sched) and each phase dispatches through THIS entry
+        point as its own batch; the return value is then a
+        :class:`~sdnmpi_tpu.sched.program.PhasedFlowProgram`, not a
+        RouteWindow. 0 = auto phase count, > 0 = that many (pow2-
+        rounded). See :meth:`routes_collective_phased_dispatch`.
         """
         from sdnmpi_tpu.oracle.adaptive import link_loads
         from sdnmpi_tpu.oracle.batch import CollectiveRoutes, RouteWindow
 
         from sdnmpi_tpu import native
+
+        if schedule is not None:
+            return self.routes_collective_phased_dispatch(
+                db, macs, src_idx, dst_idx, policy,
+                n_phases=int(schedule), link_util=link_util, alpha=alpha,
+                link_capacity=link_capacity, ecmp_ways=ecmp_ways,
+                rounds=rounds, ugal_candidates=ugal_candidates,
+                ugal_bias=ugal_bias,
+            )
 
         t = self.refresh(db)
         src_idx = np.ascontiguousarray(src_idx, dtype=np.int32)
@@ -1669,7 +1722,41 @@ class RouteOracle:
         # deal each group's members across its sub-flows by endpoint
         # hash (native O(F) kernels; no per-group sort) — deterministic,
         # and distinct sub-flows draw distinct sampled paths downstream
-        if fused is not None:
+        if _phase_scan is not None:
+            # exact round-robin deal (phased leg only): the phase-grain
+            # scanner balances the batch assuming each sub-flow carries
+            # exactly sub_w members, so the installed member traffic
+            # must match it — the hash deal's collisions leave some
+            # weight-1 sub-flows carrying 0 and others 2-3 members,
+            # which re-opens ~6% discrete congestion above what the
+            # scanner placed (measured at the config-3 shape). Dealing
+            # members by their rank within the group caps the skew at
+            # ceil/floor of counts/nsub — zero at the full split the
+            # phased dispatch aims for.
+            if fused is not None:
+                lookup = np.zeros(vv, np.int64)
+                lookup[uniq] = np.arange(len(uniq))
+                okm = key_all >= 0
+                all_ok = bool(okm.all())
+                inv_ok = lookup[key_all if all_ok else key_all[okm]]
+            else:
+                okm = ok
+                inv_ok = inv
+            order = np.argsort(inv_ok, kind="stable")
+            starts = np.zeros(len(uniq), np.int64)
+            np.cumsum(counts[:-1], out=starts[1:])
+            g_ord = inv_ok[order]
+            pos = np.arange(len(g_ord), dtype=np.int64) - starts[g_ord]
+            dealt = np.empty(len(g_ord), np.int32)
+            dealt[order] = (
+                sub_base[g_ord] + pos % nsub[g_ord]
+            ).astype(np.int32)
+            if all_ok:
+                pair_sub = dealt
+            else:
+                pair_sub = np.full(f, -1, np.int32)
+                pair_sub[okm] = dealt
+        elif fused is not None:
             lookup = np.zeros(vv, np.int64)
             lookup[uniq] = np.arange(len(uniq))
             pair_sub = native.deal_subflows_keyed(
@@ -1700,7 +1787,47 @@ class RouteOracle:
 
         base = self._normalized_base(db, t, link_util, alpha, link_capacity, f)
         inter_h = None
-        if policy == "adaptive":
+        if policy == "balanced" and _phase_scan is not None:
+            # phase-grain scanner leg (ISSUE 8, phased dispatch only):
+            # one phase is a SMALL near-matching, and closing the
+            # discrete-vs-fractional gap there needs per-flow load
+            # FEEDBACK, not independent sampling — the DAG sampler's
+            # hash-weighted choices are mutually blind, so each phase
+            # would pay O(sqrt(load)) rounding noise and K phases would
+            # pay it K times (measured: ~3.5x the bound at K=16). The
+            # greedy scanner at chunk=_phase_scan routes each sub-flow
+            # against the load every earlier sub-flow placed (ties
+            # dealt round-robin by flow id within a chunk), landing
+            # each phase within ~1 flow of its ideal split. The phased
+            # dispatch splits groups toward weight-1 sub-flows
+            # (PHASE_SUBFLOW_BUDGET) so the quantum the greedy moves
+            # matches the small per-phase per-link loads.
+            from sdnmpi_tpu.oracle.batch import pad_flow_batch
+            from sdnmpi_tpu.oracle.congestion import route_flows_balanced
+
+            src_p, dst_p = pad_flow_batch(
+                sub_src.astype(np.int32), sub_dst.astype(np.int32),
+                pow2=True,
+            )
+            w_p = np.zeros(len(src_p), np.float32)
+            w_p[:n_sub] = sub_w
+            nodes_d, _, _ = route_flows_balanced(
+                t.adj,
+                self._dist_d,
+                base.astype(jnp.float32) if isinstance(base, jax.Array)
+                else jnp.asarray(base.astype(np.float32)),
+                jnp.asarray(src_p),
+                jnp.asarray(dst_p),
+                jnp.asarray(w_p),
+                max_len,
+                chunk=int(_phase_scan),
+                max_degree=t.max_degree,
+            )
+            _start_host_copy(nodes_d)
+
+            def paths_reap() -> np.ndarray:
+                return np.asarray(nodes_d)[:n_sub]
+        elif policy == "adaptive":
             from sdnmpi_tpu.oracle.adaptive import stitch_paths
 
             inter_h, n1, n2 = self._adaptive_paths(
@@ -1760,13 +1887,159 @@ class RouteOracle:
                 link_loads(paths, counts_sub, t.v).max(initial=0.0)
             )
             self._note_congestion(
-                routes.max_congestion, dag=policy == "balanced"
+                routes.max_congestion, dag=policy == "balanced",
+                phase=_phase or _phase_scan is not None,
             )
             if inter_h is not None:
                 routes.n_detours = int(counts_sub[inter_h >= 0].sum())
             return routes
 
         return RouteWindow(reap)
+
+    # -- phased collective scheduling (sdnmpi_tpu/sched; ISSUE 8) ----------
+
+    @_timed_batch("routes_collective_phased")
+    def routes_collective_phased(
+        self,
+        db: "TopologyDB",
+        macs: list[str],
+        src_idx: np.ndarray,
+        dst_idx: np.ndarray,
+        policy: str = "balanced",
+        n_phases: int = 0,
+        **kwargs,
+    ):
+        """Blocking twin of :meth:`routes_collective_phased_dispatch`:
+        every phase reaped in order before returning the program."""
+        program = self.routes_collective_phased_dispatch(
+            db, macs, src_idx, dst_idx, policy, n_phases=n_phases, **kwargs
+        )
+        program.reap_all()
+        return program
+
+    @_timed_batch("routes_collective_phased_dispatch")
+    def routes_collective_phased_dispatch(
+        self,
+        db: "TopologyDB",
+        macs: list[str],
+        src_idx: np.ndarray,
+        dst_idx: np.ndarray,
+        policy: str = "balanced",
+        n_phases: int = 0,
+        link_util: Optional[dict[tuple[int, int], float]] = None,
+        alpha: float = 1.0,
+        link_capacity: float = 10e9,
+        scan_chunk: int = 1,
+        **kwargs,
+    ):
+        """Jointly decompose a collective into phases and route each one.
+
+        The scheduler half of ISSUE 8 (Efficient All-to-All Schedules,
+        arxiv 2309.13541; RAMP, arxiv 2211.15226): the collective's
+        pairs are aggregated into (edge switch, edge switch) traffic
+        groups exactly like the flat path's ECMP grouping, the groups
+        are packed into ``n_phases`` (0 = auto,
+        :func:`sdnmpi_tpu.sched.choose_n_phases`) phases by the jitted
+        greedy link-load-aware packer — seeded with the utilization
+        plane's per-switch load so measured background traffic steers
+        the packing — and each phase's pair subset is dispatched
+        through :meth:`routes_collective_dispatch` as its own batch.
+        All K device programs are enqueued back to back (JAX async
+        dispatch) before this method returns, so a caller that reaps
+        and installs phase k overlaps phases k+1..K's device compute —
+        phasing adds pipeline depth, not serial route latency.
+
+        With the (default) "balanced" policy the per-phase batches route
+        through the greedy scanner's phase-grain leg (``_phase_scan`` =
+        ``scan_chunk``; see :meth:`routes_collective_dispatch`): online
+        load feedback plus near-weight-1 sub-flow splitting
+        (sched.PHASE_SUBFLOW_BUDGET) lands every phase within ~1 flow
+        of its fractional split — the property that makes the program's
+        summed congestion approach the flat batch's fractional bound
+        (<= 1.15x at the config-3 shape vs ~1.5x single-shot; the
+        independent-sampling DAG engine cannot do this for small
+        phases, measured ~3.5x). "shortest"/"adaptive" phases route
+        exactly as their flat batches would.
+
+        Returns a :class:`~sdnmpi_tpu.sched.program.PhasedFlowProgram`;
+        per-phase windows reap ordinary ``CollectiveRoutes`` restricted
+        to their ``pair_idx`` subset. Pairs whose endpoints do not
+        resolve are in no phase (``pair_phase == -1``), matching the
+        flat path's unrouted contract.
+        """
+        from sdnmpi_tpu.sched import choose_n_phases, pack_phases
+        from sdnmpi_tpu.sched.program import PhasedFlowProgram, PhasePlan
+
+        t = self.refresh(db)
+        src_idx = np.ascontiguousarray(src_idx, dtype=np.int32)
+        dst_idx = np.ascontiguousarray(dst_idx, dtype=np.int32)
+        f = src_idx.shape[0]
+        edge, _ = self._resolve_endpoints_array(db, t, macs)
+        src_sw = edge[src_idx]
+        dst_sw = edge[dst_idx]
+        ok = (src_sw >= 0) & (dst_sw >= 0)
+        pair_phase = np.full(f, -1, np.int32)
+        k = choose_n_phases(0, n_phases)
+        if ok.any():
+            # aggregate to (edge, edge) groups — the shared group-build
+            # (sched.aggregate_groups: dense-key bincount, same-switch
+            # zero-weighting), identical to the py backend's fallback
+            from sdnmpi_tpu.sched.phases import aggregate_groups
+
+            key, uniq, inv, counts, g_src, g_dst, w_pack = (
+                aggregate_groups(src_sw[ok], dst_sw[ok], t.v)
+            )
+            k = choose_n_phases(len(uniq), n_phases)
+            # per-switch background load from the SAME normalized base
+            # the balancer scores with: measured bps -> flow-equivalent
+            # units, so packer and balancer read one congestion signal.
+            # A UtilPlane base reduces on device (no [V, V] download).
+            base = self._normalized_base(
+                db, t, link_util, alpha, link_capacity, max(1, f)
+            )
+            if isinstance(base, jax.Array):
+                util_out, util_in = base.sum(axis=1), base.sum(axis=0)
+            else:
+                b = np.asarray(base, np.float32)
+                util_out = b.sum(axis=1, dtype=np.float32)
+                util_in = b.sum(axis=0, dtype=np.float32)
+            group_phase = pack_phases(
+                g_src, g_dst, w_pack, k, t.v, util_out, util_in,
+            )
+            pair_phase[ok] = group_phase[inv]
+
+        phases: list[PhasePlan] = []
+        for p in range(k):
+            sel = np.nonzero(pair_phase == p)[0]
+            if not len(sel):
+                continue  # the packer left this phase empty
+            phase_kwargs = dict(kwargs)
+            # every phased sub-batch marks its reap, whatever the
+            # policy: shortest/adaptive phases have no scanner leg but
+            # must equally leave the flat congestion triple alone
+            phase_kwargs["_phase"] = True
+            if policy == "balanced":
+                from sdnmpi_tpu.sched.phases import PHASE_SUBFLOW_BUDGET
+
+                # split the phase's groups toward weight-1 sub-flows
+                # under the scanner budget: the greedy's move quantum
+                # must stay small relative to per-phase link loads
+                # groups landing in this phase, from the packer's own
+                # [G] assignment — no per-phase unique over the [F]
+                # pair keys
+                n_groups = max(1, int((group_phase == p).sum()))
+                phase_kwargs["ecmp_ways"] = max(
+                    phase_kwargs.get("ecmp_ways", 4),
+                    -(-PHASE_SUBFLOW_BUDGET // n_groups),
+                )
+                phase_kwargs["_phase_scan"] = int(scan_chunk)
+            window = self.routes_collective_dispatch(
+                db, macs, src_idx[sel], dst_idx[sel], policy,
+                link_util=link_util, alpha=alpha,
+                link_capacity=link_capacity, **phase_kwargs,
+            )
+            phases.append(PhasePlan(p, sel, window))
+        return PhasedFlowProgram(k, pair_phase, phases)
 
     # -- raw matrices (for congestion scoring / bench / sharding) ---------
 

@@ -1,0 +1,27 @@
+"""Device-side collective phase scheduler (ISSUE 8).
+
+The DAG balancer routes a collective as ONE flat rank-pair batch; its
+discrete sampling lands ~45% above its own fractional max-link bound at
+the flagship shape — and that gap IS scheduling (ROADMAP, arxiv
+2309.13541 / RAMP 2211.15226): executing the collective as K smaller,
+link-disjoint(ish) *phases* lets each phase's flows round onto nearly
+empty links, so the program's total congestion approaches the flat
+batch's fractional bound. This package holds the scheduler:
+
+- :mod:`sdnmpi_tpu.sched.phases` — greedy link-load-aware phase packing
+  of the collective's (edge, edge) traffic groups, computed on device
+  under ``jit`` (seeded with the UtilPlane's measured per-switch load),
+  with a bit-exact host/numpy differential twin.
+- :mod:`sdnmpi_tpu.sched.program` — the *phased flow program* the
+  oracle returns: an ordered list of per-phase route windows the Router
+  installs phase by phase through the PR-3 pipelined install plane,
+  with each phase boundary barrier-acked via the PR-5 recovery plane.
+"""
+
+from sdnmpi_tpu.sched.phases import (  # noqa: F401
+    MAX_AUTO_PHASES,
+    choose_n_phases,
+    pack_phases,
+    pack_phases_host,
+)
+from sdnmpi_tpu.sched.program import PhasedFlowProgram, PhasePlan  # noqa: F401

@@ -9,6 +9,8 @@ actually touches.
 import asyncio
 import json
 
+import pytest
+
 from sdnmpi_tpu import launch
 
 
@@ -298,6 +300,19 @@ class TestRecoveryFlags:
         assert cfg.install_retry_backoff_s == 0.5
         assert cfg.echo_interval_s == 3.0 and cfg.echo_timeout_s == 9.0
         assert args.chaos == 42
+
+    def test_schedule_phases_flag_maps_to_config(self):
+        """--schedule-phases arms the collective phase scheduler; omitted
+        it stays off (the bit-identical single-shot default)."""
+        cfg = launch.config_from_args(_parse([]))
+        assert not cfg.schedule_collectives and cfg.schedule_phases == 0
+        cfg = launch.config_from_args(_parse(["--schedule-phases", "0"]))
+        assert cfg.schedule_collectives and cfg.schedule_phases == 0
+        cfg = launch.config_from_args(_parse(["--schedule-phases", "8"]))
+        assert cfg.schedule_collectives and cfg.schedule_phases == 8
+        # a negative K is an operator typo, not silent auto mode
+        with pytest.raises(SystemExit):
+            _parse(["--schedule-phases", "-4"])
 
     def test_chaos_live_run_survives(self, tmp_path):
         """A short live run with the chaos plan armed must exit cleanly

@@ -1,0 +1,931 @@
+"""Device-side collective phase scheduler (ISSUE 8, sdnmpi_tpu/sched).
+
+Four legs under test:
+
+1. the packer — the jitted greedy link-load-aware phase packing must be
+   BIT-EXACT against its numpy host twin across shapes, phase counts,
+   and background loads, with the pow2 bucketing keeping the jit cache
+   bounded across a storm of differently-sized collectives;
+2. the program contract — phases partition the collective's resolved
+   pairs exactly, on both the jax and pure-Python backends, and with
+   the "shortest" policy (phases route exactly as their flat batches
+   would) the phased install's switch tables equal the flat install's
+   bit-for-bit, sim + wire;
+3. the schedule QUALITY acceptance — at the config-3-shaped workload
+   (full alltoall on a fat-tree) the scheduled program's summed
+   discrete max-link congestion lands within 1.15x of the flat batch's
+   fractional bound, while the flat discrete figure sits ~1.45x above
+   it (the gap the scheduler exists to close);
+4. failure-domain behavior — a switch that crashes and redials BETWEEN
+   phase k and k+1 reconciles to exactly the phases installed so far
+   (installed == desired, sim + wire), and a seeded FaultPlan send-drop
+   soak over a phased install converges to installed == desired after
+   quiesce.
+
+``Config.schedule_collectives=False`` (the default) must leave the flat
+single-shot path untouched — pinned by the escape-hatch tests.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.faults import FaultPlan
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+from sdnmpi_tpu.sched import (
+    MAX_AUTO_PHASES,
+    choose_n_phases,
+    pack_phases,
+    pack_phases_host,
+)
+from sdnmpi_tpu.oracle.batch import bucket_pow2
+from sdnmpi_tpu.sched.program import PhasedFlowProgram
+from sdnmpi_tpu.topogen import fattree
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+N_RANKS = 8
+
+#: recovery knobs for synchronous tests (same as tests/test_recovery.py)
+FAST_RECOVERY = dict(
+    install_retry_backoff_s=0.0,
+    barrier_timeout_s=0.0,
+    install_retry_max=3,
+)
+
+
+def make_stack(wire: bool = False, **config_kw):
+    """fattree(4) fabric + controller with the block install path forced
+    on at toy scale (the tests/test_collective_blocks.py idiom), ranks
+    announced."""
+    spec = fattree(4)  # 20 switches, 16 hosts
+    fabric = spec.to_fabric(wire=wire)
+    config = Config(block_install_threshold=1, **{**FAST_RECOVERY, **config_kw})
+    controller = Controller(fabric, config)
+    controller.attach()
+    macs = sorted(fabric.hosts)[:N_RANKS]
+    for rank, mac in enumerate(macs):
+        fabric.hosts[mac].send(of.Packet(
+            eth_src=mac,
+            eth_dst="ff:ff:ff:ff:ff:ff",
+            eth_type=of.ETH_TYPE_IP,
+            ip_proto=of.IPPROTO_UDP,
+            udp_dst=61000,
+            payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+        ))
+    return fabric, controller, macs
+
+
+def kickoff(fabric, macs, coll_type=CollectiveType.ALLTOALL, src=0, dst=1):
+    vmac = VirtualMac(coll_type, src, dst).encode()
+    fabric.hosts[macs[src]].send(
+        of.Packet(eth_src=macs[src], eth_dst=vmac, eth_type=of.ETH_TYPE_IP)
+    )
+
+
+def installed_flows(fabric):
+    """Router-installed exact-L2 flows on every switch (bootstrap rules
+    have wildcarded dl_src and are filtered out)."""
+    return {
+        (d, e.match.dl_src, e.match.dl_dst, e.actions, e.priority)
+        for d, sw in fabric.switches.items()
+        for e in sw.flow_table
+        if e.match.dl_src is not None
+    }
+
+
+def desired_flows(controller):
+    """The desired store rendered in the installed_flows shape — the
+    byte-identity oracle for reconciliation."""
+    prio = controller.config.priority_default
+    out = set()
+    for d, table in controller.router.recovery.desired.flows.items():
+        for (src, dst), spec in table.items():
+            actions: tuple = (of.ActionOutput(spec.out_port),)
+            if spec.rewrite:
+                actions = (of.ActionSetDlDst(spec.rewrite),) + actions
+            out.add((d, src, dst, actions, prio))
+    return out
+
+
+def alltoall_idx(n: int):
+    src, dst = np.nonzero(~np.eye(n, dtype=bool))
+    return src.astype(np.int32), dst.astype(np.int32)
+
+
+# -- leg 1: the packer -----------------------------------------------------
+
+
+class TestPacker:
+    def test_device_matches_host_bit_exact(self):
+        """The jitted greedy scan and its numpy twin must agree on every
+        assignment — same f32 arithmetic, same heaviest-first order,
+        same lowest-phase tie rule."""
+        rng = np.random.default_rng(7)
+        for g, v, k in [(1, 4, 2), (5, 8, 2), (37, 20, 4), (200, 64, 8),
+                        (63, 16, 4), (64, 16, 4), (65, 16, 4)]:
+            s = rng.integers(0, v, g).astype(np.int32)
+            d = rng.integers(0, v, g).astype(np.int32)
+            w = (rng.random(g) * 10).astype(np.float32)
+            uo = rng.random(v).astype(np.float32)
+            ui = rng.random(v).astype(np.float32)
+            dev = pack_phases(s, d, w, k, v, uo, ui, device=True)
+            host = pack_phases(s, d, w, k, v, uo, ui, device=False)
+            assert (dev == host).all(), (g, v, k)
+            assert dev.min() >= 0 and dev.max() < k
+
+    def test_background_load_steers_packing(self):
+        """A hot switch's measured load must displace traffic: with a
+        heavy util_out on one source, its groups spread across phases
+        exactly as the host twin predicts (and differently than idle)."""
+        s = np.zeros(8, np.int32)  # all groups inject at switch 0
+        d = np.arange(8, dtype=np.int32)
+        w = np.ones(8, np.float32)
+        idle = pack_phases(s, d, w, 4, 8, device=False)
+        hot_in = np.zeros(8, np.float32)
+        hot_in[3] = 100.0  # drowning destination 3
+        hot = pack_phases(s, d, w, 4, 8, util_in=hot_in, device=False)
+        # group heading to the drowned switch still gets a phase; the
+        # packer cannot fix a single-destination hotspot, but the
+        # assignment must stay a valid phase id and the rest balanced
+        assert hot.min() >= 0 and hot.max() < 4
+        counts = np.bincount(idle, minlength=4)
+        assert counts.max() - counts.min() <= 1  # idle: perfectly dealt
+
+    def test_empty_and_all_pad_groups(self):
+        assert len(pack_phases(
+            np.empty(0, np.int32), np.empty(0, np.int32),
+            np.empty(0, np.float32), 4, 8,
+        )) == 0
+        host = pack_phases_host(
+            np.array([-1, -1], np.int32), np.array([-1, -1], np.int32),
+            np.array([1.0, 1.0], np.float32),
+            np.zeros(4, np.float32), np.zeros(4, np.float32), 2,
+        )
+        assert (host == -1).all()
+
+    def test_choose_n_phases_ladder(self):
+        # requested counts snap UP to the pow2 ladder
+        assert choose_n_phases(50, 3) == 4
+        assert choose_n_phases(50, 4) == 4
+        assert choose_n_phases(50, 5) == 8
+        # ...but clamp at MAX_AUTO_PHASES: the ladder (and the packer's
+        # jit cache) stays bounded however large --schedule-phases is
+        assert choose_n_phases(50, 10_000) == MAX_AUTO_PHASES
+        # an explicit single-phase request is honored: the 1-phase
+        # control an operator compares the schedule against
+        assert choose_n_phases(50, 1) == 1
+        # auto: K=4, K=2 for collectives too small to fill 4 phases
+        assert choose_n_phases(50, 0) == 4
+        assert choose_n_phases(6, 0) == 2
+        # the ladder shares the canonical pow2 bucketing helper, which
+        # honors a sub-8 floor for the 1-phase control
+        assert bucket_pow2(9, floor=1) == 16
+        assert bucket_pow2(1, floor=1) == 1
+
+    def test_pow2_bucketing_bounds_traces(self):
+        """A storm of differently-sized collectives must not retrace the
+        packer: every G in one pow2 bucket shares one compiled scan."""
+        from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+        rng = np.random.default_rng(3)
+
+        def pack(g):
+            s = rng.integers(0, 8, g).astype(np.int32)
+            d = rng.integers(0, 8, g).astype(np.int32)
+            pack_phases(s, d, np.ones(g, np.float32), 4, 8, device=True)
+
+        pack(9)  # warm the 16-bucket trace
+        before = TRACE_COUNTS.get("sched_pack", 0)
+        for g in (9, 10, 13, 16):  # all in the 16 bucket
+            pack(g)
+        assert TRACE_COUNTS.get("sched_pack", 0) == before
+        pack(17)  # next bucket: exactly one fresh trace
+        assert TRACE_COUNTS.get("sched_pack", 0) == before + 1
+
+
+# -- leg 2: the program contract -------------------------------------------
+
+
+class TestProgramContract:
+    @pytest.mark.parametrize("backend", ["jax", "py"])
+    def test_phases_partition_pairs(self, backend):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend=backend)
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        src, dst = alltoall_idx(N_RANKS)
+        prog = db.find_routes_collective_phased(
+            macs, src, dst, policy="shortest"
+        )
+        assert isinstance(prog, PhasedFlowProgram)
+        assert prog.n_phases == 4
+        assert prog.n_pairs == len(src)
+        # every resolved pair lives in exactly one phase
+        got = np.sort(np.concatenate([p.pair_idx for p in prog.phases]))
+        assert (got == np.nonzero(prog.pair_phase >= 0)[0]).all()
+        assert (prog.pair_phase >= 0).all()  # all endpoints resolve here
+        for plan in prog.phases:
+            assert (prog.pair_phase[plan.pair_idx] == plan.phase).all()
+            routes = plan.reap()
+            assert routes.n_pairs == plan.n_pairs
+
+    @pytest.mark.parametrize("backend", ["jax", "py"])
+    def test_unresolved_endpoints_in_no_phase(self, backend):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend=backend)
+        macs = sorted(m for m, _, _ in spec.hosts)[:4]
+        macs[2] = "aa:bb:cc:dd:ee:ff"  # not attached anywhere
+        src = np.array([0, 1, 2, 3], np.int32)
+        dst = np.array([1, 2, 3, 0], np.int32)
+        prog = db.find_routes_collective_phased(
+            macs, src, dst, policy="shortest"
+        )
+        assert (prog.pair_phase[[1, 2]] == -1).all()  # pairs touching it
+        assert (prog.pair_phase[[0, 3]] >= 0).all()
+
+    def test_jax_and_py_programs_agree_on_grouping(self):
+        """The device packer and the py backend's host twin must derive
+        the SAME pair -> phase map for the same collective (the packer
+        is bit-exact and both aggregate groups in sorted-dpid compact
+        index space)."""
+        spec = fattree(4)
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        src, dst = alltoall_idx(N_RANKS)
+        progs = [
+            spec.to_topology_db(backend=b).find_routes_collective_phased(
+                macs, src, dst, policy="shortest"
+            )
+            for b in ("jax", "py")
+        ]
+        assert progs[0].n_phases == progs[1].n_phases
+        assert (progs[0].pair_phase == progs[1].pair_phase).all()
+
+    def test_backends_agree_with_heavy_same_switch_groups(self):
+        """Same-switch groups pack with ZERO weight on both backends: a
+        heavy same-switch group (no links ridden) must not displace
+        cross-switch traffic from a phase's load budget, and the
+        jax/py pair -> phase maps must stay identical for skewed
+        collectives too."""
+        spec = fattree(4)
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        # 20 copies of a same-switch pair + cross pairs that collide on
+        # their destination switch (hosts 4 and 5 share one edge switch
+        # in fattree(4), as do 6 and 7)
+        src = np.array([0] * 20 + [0, 2, 1, 3], np.int32)
+        dst = np.array([1] * 20 + [4, 5, 6, 7], np.int32)
+        progs = [
+            spec.to_topology_db(backend=b).find_routes_collective_phased(
+                macs, src, dst, policy="shortest"
+            )
+            for b in ("jax", "py")
+        ]
+        assert progs[0].n_phases == progs[1].n_phases
+        assert (progs[0].pair_phase == progs[1].pair_phase).all()
+        # groups colliding on a destination switch split into distinct
+        # phases despite the heavy same-switch group (zero weight: it
+        # claims no budget anywhere)
+        ph = progs[0].pair_phase
+        assert ph[20] != ph[21]  # both head to the 4/5 edge switch
+        assert ph[22] != ph[23]  # both head to the 6/7 edge switch
+
+    @pytest.mark.parametrize("wire", [False, True])
+    def test_shortest_phased_tables_equal_flat_expansion(self, wire):
+        """With the "shortest" policy each phase routes exactly as its
+        flat batch would, so the phased program's installed switch
+        tables must equal the member x hop expansion of the FLAT
+        routes bit-for-bit (the flat block install keeps those rows as
+        fabric block entries, so the expansion is built from the
+        oracle's own flat answer) — the whole-program differential,
+        sim + wire."""
+        fabric, controller, macs = make_stack(
+            wire=wire, collective_policy="shortest",
+            schedule_collectives=True,
+        )
+        kickoff(fabric, macs)
+        install = next(iter(controller.router.collectives))
+
+        flat = controller.bus.request(ev.FindCollectiveRoutesRequest(
+            install.macs, install.src_idx, install.dst_idx,
+            policy="shortest",
+        )).routes
+        prio = controller.config.priority_default
+        expected = set()
+        for k in range(flat.n_pairs):
+            fdb = flat.fdb(k)
+            if not fdb:
+                continue
+            si = int(install.src_idx[k])
+            di = int(install.dst_idx[k])
+            src = install.macs[si]
+            true_dst = install.macs[di]
+            vmac = VirtualMac(CollectiveType.ALLTOALL, si, di).encode()
+            for h, (dpid, port) in enumerate(fdb):
+                actions: tuple = (of.ActionOutput(port),)
+                if h == len(fdb) - 1:
+                    actions = (of.ActionSetDlDst(true_dst),) + actions
+                expected.add((dpid, src, vmac, actions, prio))
+        assert expected
+        # the kickoff packet's own reactive flow is pair (0, 1)'s row —
+        # identical match, actions, and priority — so set equality holds
+        # with no filtering
+        assert installed_flows(fabric) == expected
+        # and the phased install's desired store covers the same rows
+        assert desired_flows(controller) == expected
+
+    def test_schedule_off_stays_flat(self):
+        """The escape hatch: Config.schedule_collectives defaults False
+        and the install must take the flat block path — no phase
+        events, no phase bookkeeping, block-plane teardown."""
+        fabric, controller, macs = make_stack()
+        phases = []
+        controller.bus.subscribe(
+            ev.EventCollectivePhaseInstalled, lambda e: phases.append(e)
+        )
+        kickoff(fabric, macs)
+        assert phases == []
+        install = next(iter(controller.router.collectives))
+        assert install.n_phases == 0
+        assert install.phase_links is None and install.phase_rows is None
+        # flat installs carry no collective-flagged desired rows (block
+        # plane bookkeeping is the collective table + fabric blocks,
+        # bit-identical to the pre-scheduler path; the kickoff packet's
+        # reactive flow is the only desired row)
+        assert not [
+            spec
+            for table in controller.router.recovery.desired.flows.values()
+            for spec in table.values()
+            if spec.collective
+        ]
+        controller.router._remove_collective(install)
+        # block-plane teardown: the fabric's block entries are gone (the
+        # reactive kickoff flow is FDB-owned and stays)
+        assert len(controller.router.collectives) == 0
+
+    def test_send_desired_split_verdict_lists_dpid_once(self):
+        """The collective/non-collective burst split re-drives one
+        switch as up to TWO sends; both failing must merge to ONE
+        dropped entry (note_send schedules a retry per entry, so a
+        duplicate burns two attempts per actual failure), and a
+        half-failed split keeps the dpid out of sent (dropped wins)."""
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        kickoff(fabric, macs)
+        router = controller.router
+        victim = max(
+            router.recovery.desired.flows,
+            key=lambda d: len(router.recovery.desired.flows[d]),
+        )
+        # one non-collective row beside the phase rows forces the split
+        router.recovery.desired.record(
+            victim, "02:00:00:00:00:01", "02:00:00:00:00:02", 1
+        )
+        rows = [
+            (src, dst, spec)
+            for (src, dst), spec in
+            router.recovery.desired.flows[victim].items()
+        ]
+        assert {spec.collective for _, _, spec in rows} == {True, False}
+        from sdnmpi_tpu.control.recovery import InstallVerdict
+
+        calls = []
+
+        def drop_window(dpids, burst):
+            calls.append(len(dpids))
+            return InstallVerdict(dropped=[victim])
+
+        router._send_window = drop_window
+        verdict = router._send_desired(victim, rows)
+        assert len(calls) == 2, "both split parts must send"
+        assert verdict.dropped == [victim]
+        assert verdict.sent == []
+
+    def test_dead_datapath_rows_excluded_from_phase_accounting(self):
+        """A switch that drops out of self.dps between routing and
+        install (down race) still gets rows MATERIALIZED for it, but
+        they never ship — the phase events, CollectiveInstall.n_flows,
+        the desired store, and phase_rows must all count the same LIVE
+        set, or teardown/reconcile cover fewer rows than the table
+        claims installed."""
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        kickoff(fabric, macs)
+        first = next(iter(controller.router.collectives))
+        victim = max(first.switches)
+        baseline = first.n_flows
+        controller.router._remove_collective(first)
+        phases, installed = [], []
+        controller.bus.subscribe(
+            ev.EventCollectivePhaseInstalled, lambda e: phases.append(e)
+        )
+        controller.bus.subscribe(
+            ev.EventCollectiveInstalled, lambda e: installed.append(e)
+        )
+        # the race: topology still routes THROUGH the victim, but the
+        # datapath set no longer lists it (no EventDatapathDown yet).
+        # Kick off via a pair whose reactive flow did NOT survive the
+        # teardown (the (0,1) kickoff flow is FDB-owned and stays, so
+        # its packet would be switched without a packet-in)
+        controller.router.dps.discard(victim)
+        kickoff(fabric, macs, src=1, dst=2)
+        install = next(iter(controller.router.collectives))
+        assert install.n_flows < baseline, "victim rows must be dead"
+        assert sum(e.n_flows for e in phases) == install.n_flows
+        assert installed[0].n_flows == install.n_flows
+        assert sum(
+            len(arr) for _, arr in install.phase_rows
+        ) == install.n_flows
+        # every shipped row is desired, and none lives on the dead
+        # switch (the dead rows never entered the store)
+        from sdnmpi_tpu.control.router import _mac_rows
+
+        memo: dict = {}
+        desired = controller.router.recovery.desired.flows
+        shipped = [
+            row
+            for _, arr in install.phase_rows
+            for row in _mac_rows(arr, memo)
+        ]
+        assert len(shipped) == install.n_flows
+        assert all((s, t) in desired.get(d, {}) for d, s, t in shipped)
+        assert all(d != victim for d, _, _ in shipped)
+        assert not any(
+            spec.collective for spec in desired.get(victim, {}).values()
+        )
+
+    def test_phase_events_ascend_and_table_records_program(self):
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        phases, installed = [], []
+        controller.bus.subscribe(
+            ev.EventCollectivePhaseInstalled, lambda e: phases.append(e)
+        )
+        controller.bus.subscribe(
+            ev.EventCollectiveInstalled, lambda e: installed.append(e)
+        )
+        kickoff(fabric, macs)
+        assert len(installed) == 1
+        assert phases, "a scheduled install must publish phase events"
+        assert [e.phase for e in phases] == sorted(e.phase for e in phases)
+        assert all(e.n_phases == phases[0].n_phases for e in phases)
+        assert sum(e.n_pairs for e in phases) == installed[0].n_pairs
+        assert sum(e.n_flows for e in phases) == installed[0].n_flows
+        install = next(iter(controller.router.collectives))
+        assert install.n_phases == phases[0].n_phases
+        assert [p for p, _ in install.phase_rows] == [e.phase for e in phases]
+        # the program's desired rows are exactly its phase rows
+        assert controller.router.recovery.desired.total() == sum(
+            len(rows) for _, rows in install.phase_rows
+        )
+        # phase-grain link attribution: every ridden link names only
+        # phases the program actually installed
+        live = {e.phase for e in phases}
+        assert install.phase_links
+        for link, ps in install.phase_links.items():
+            assert link in install.links
+            assert set(ps) <= live
+        # registry phase progress (the telemetry / RPC mirror payload)
+        assert REGISTRY.get("sched_programs_total").value >= 1
+        assert REGISTRY.get("sched_program_completion").value >= max(
+            e.max_congestion for e in phases
+        )
+
+    def test_phased_delivery_and_teardown(self):
+        """Every rank pair delivers through its phase's flows (last hop
+        rewrites the virtual MAC), and teardown by phase rows removes
+        every program-owned row — only FDB-owned rows (the kickoff
+        packet's reactive flow, byte-identical to a phase row under
+        the store's first-writer-wins rule) survive, still matching
+        the desired store exactly."""
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        kickoff(fabric, macs)
+        for s in range(N_RANKS):
+            for d in range(N_RANKS):
+                if s == d:
+                    continue
+                before = len(fabric.hosts[macs[d]].received)
+                vmac = VirtualMac(CollectiveType.ALLTOALL, s, d).encode()
+                fabric.hosts[macs[s]].send(of.Packet(
+                    eth_src=macs[s], eth_dst=vmac, eth_type=of.ETH_TYPE_IP
+                ))
+                got = fabric.hosts[macs[d]].received[before:]
+                assert got, f"pair {s}->{d} not delivered"
+                assert got[-1].eth_dst == macs[d]
+        install = next(iter(controller.router.collectives))
+        controller.router._remove_collective(install)
+        # teardown removed every collective-owned row; what's left on
+        # the wire is exactly the FDB-owned desired set
+        assert installed_flows(fabric) == desired_flows(controller)
+        assert not any(
+            spec.collective
+            for table in controller.router.recovery.desired.flows.values()
+            for spec in table.values()
+        )
+
+    def test_pipelined_install_off_takes_the_scalar_leg(self):
+        """pipelined_install=False is the scalar differential escape
+        hatch: a phased install must ship one scalar FlowMod per row
+        (never a batched window), land byte-identical rows, and still
+        converge installed == desired."""
+        fabric, controller, macs = make_stack(
+            schedule_collectives=True, pipelined_install=False,
+        )
+
+        def banned(*a, **k):  # pragma: no cover - the assertion IS the test
+            raise AssertionError(
+                "batched path used with pipelined_install=False"
+            )
+
+        fabric.flow_mods_window = banned
+        fabric.flow_mods_batch = banned
+        kickoff(fabric, macs)
+        install = next(iter(controller.router.collectives))
+        assert install.n_phases > 0
+        assert installed_flows(fabric) == desired_flows(controller)
+        # scalar phased rows are permanent, like the batched leg's
+        for sw in fabric.switches.values():
+            for e in sw.flow_table:
+                if e.match.dl_src is not None:
+                    assert e.idle_timeout == 0 and e.hard_timeout == 0
+
+    def test_scalar_reconcile_redrives_phase_rows_permanent(self):
+        """The scalar _send_desired leg must re-drive collective rows
+        WITHOUT the config flow timeouts (their fresh install is
+        permanent): after a crash + redial under pipelined_install=False
+        and flow_idle_timeout set, the re-driven phase rows carry zero
+        timeouts."""
+        fabric, controller, macs = make_stack(
+            schedule_collectives=True, pipelined_install=False,
+            flow_idle_timeout=30,
+        )
+        kickoff(fabric, macs)
+        victim = max(
+            controller.router.recovery.desired.flows,
+            key=lambda d: len(controller.router.recovery.desired.flows[d]),
+        )
+        fabric.crash_switch(victim)
+        fabric.redial_switch(victim)
+        controller.router.recovery_tick()
+        assert installed_flows(fabric) == desired_flows(controller)
+        table = controller.router.recovery.desired.flows[victim]
+        redriven = [
+            e for e in fabric.switches[victim].flow_table
+            if e.match.dl_src is not None
+            and (e.match.dl_src, e.match.dl_dst) in table
+            and table[(e.match.dl_src, e.match.dl_dst)].collective
+        ]
+        assert redriven
+        for e in redriven:
+            assert e.idle_timeout == 0 and e.hard_timeout == 0
+
+    def test_py_backend_phased_install(self):
+        """The pure-Python backend's phased leg (host-twin packer +
+        scalar oracle per phase) drives the same Router install plane:
+        phases install, and installed == desired exactly."""
+        fabric, controller, macs = make_stack(
+            oracle_backend="py", schedule_collectives=True,
+        )
+        kickoff(fabric, macs)
+        install = next(iter(controller.router.collectives))
+        assert install.n_phases > 0
+        assert installed_flows(fabric) == desired_flows(controller)
+
+    def test_reroute_keeps_schedule(self):
+        """A link failure re-routes the scheduled collective through the
+        phased path again (the reinstall inherits the config), and the
+        fabric still delivers."""
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        kickoff(fabric, macs)
+        first = next(iter(controller.router.collectives))
+        a, pa, b, pb = next(
+            l for l in fabric.links
+            if not any(
+                p.peer and p.peer[0] == "host"
+                for p in fabric.switches[l[0]].ports.values()
+            )
+        )
+        fabric.remove_link(a, pa, b, pb)
+        table = list(controller.router.collectives)
+        assert len(table) == 1
+        reinstalled = table[0]
+        assert reinstalled.cookie != first.cookie
+        assert reinstalled.n_phases > 0
+        assert installed_flows(fabric) == desired_flows(controller)
+
+
+# -- leg 3: schedule quality (the acceptance bar) --------------------------
+
+
+class TestScheduleQuality:
+    def test_scheduled_congestion_within_bound(self):
+        """The config-3-shaped acceptance at test scale: full alltoall
+        on fat-tree k=8 (128 ranks, 16k pairs). The flat DAG-balanced
+        batch's discrete max-link load sits ~1.45x above its own
+        fractional bound; the scheduled program's summed per-phase
+        discrete max must land within 1.15x of that same bound — the
+        scheduling gap, closed."""
+        spec = fattree(8)
+        db = spec.to_topology_db(backend="jax")
+        macs = sorted(m for m, _, _ in spec.hosts)
+        src, dst = alltoall_idx(len(macs))
+        oracle = db._jax_oracle()
+        oracle.routes_collective(db, macs, src, dst, "balanced")
+        frac = oracle.last_fractional_congestion
+        flat_disc = oracle.last_discrete_congestion
+        assert frac > 0
+        assert flat_disc / frac > 1.3, "the flat gap the ISSUE names"
+        prog = oracle.routes_collective_phased(
+            db, macs, src, dst, "balanced"
+        )
+        total = prog.total_discrete_congestion()
+        assert total / frac <= 1.15, (
+            f"scheduled {total} vs fractional {frac}: "
+            f"{total / frac:.3f}x > 1.15x"
+        )
+        # and no single phase is hotter than the flat batch was
+        assert prog.max_phase_congestion() <= flat_disc
+
+    def test_blocking_twin_matches_dispatch(self):
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        src, dst = alltoall_idx(N_RANKS)
+        oracle = db._jax_oracle()
+        a = oracle.routes_collective(
+            db, macs, src, dst, "balanced", schedule=0
+        )
+        b = oracle.routes_collective_phased(db, macs, src, dst, "balanced")
+        assert isinstance(a, PhasedFlowProgram)
+        assert (a.pair_phase == b.pair_phase).all()
+        for pa, pb in zip(a.phases, b.phases):
+            ra, rb = pa.reap(), pb.reap()
+            assert (np.asarray(ra.pair_sub) == np.asarray(rb.pair_sub)).all()
+            assert (np.asarray(ra.hop_dpid) == np.asarray(rb.hop_dpid)).all()
+
+
+class TestScheduleBench:
+    def test_config12_rows_pass_the_committed_regression_gate(self):
+        """Config 12's machinery at test scale (fat-tree k=8, 128
+        ranks), with its rows run through the SAME regression gate the
+        committed suite drives in CI: a schedule-quality regression
+        (vs_baseline dropping > tolerance below the committed figure)
+        fails here without a TPU."""
+        import json
+        import pathlib
+
+        from benchmarks import run as bench_run
+        from benchmarks.config12_schedule import build, measure
+
+        spec, db, macs, src, dst = build(k=8, n_ranks=128)
+        m = measure(db, macs, src, dst)
+        assert m["n_phases"] == 4
+        assert m["sched_ratio"] <= 1.15  # the acceptance bar
+        rows = [
+            {
+                "config": "12",
+                "metric": "sched4_alltoall512_fattree16_completion",
+                "value": m["sched_total"], "unit": "load",
+                "vs_baseline": m["flat_discrete"] / max(m["sched_total"], 1.0),
+            },
+            {
+                "config": "12b",
+                "metric": "sched4_alltoall512_fattree16_vs_fractional",
+                "value": m["sched_ratio"], "unit": "x",
+                "vs_baseline": m["flat_ratio"] / max(m["sched_ratio"], 1e-9),
+            },
+        ]
+        root = pathlib.Path(__file__).resolve().parent.parent
+        baseline = json.loads((root / "BENCH_suite.json").read_text())
+        assert {r["config"] for r in baseline} >= {"12", "12b"}, (
+            "config 12 must be in the committed baseline"
+        )
+        assert bench_run.check_regression(rows, baseline) == []
+        # and a genuinely regressed schedule DOES fail the gate
+        bad = [dict(rows[0], vs_baseline=0.9)] + rows[1:]
+        assert bench_run.check_regression(bad, baseline)
+
+
+# -- leg 4: mid-program failure --------------------------------------------
+
+
+class TestMidProgramFailure:
+    @pytest.mark.parametrize("wire", [False, True])
+    def test_crash_between_phases_reconciles_installed_phases(self, wire):
+        """The satellite's scenario: a switch crashes after phase k hits
+        the wire and redials before phase k+1 ends — the reconciler
+        must restore exactly the phases installed so far on that
+        switch (installed == desired per phase), and the program as a
+        whole must converge to installed == desired."""
+        fabric, controller, macs = make_stack(
+            wire=wire, schedule_collectives=True,
+        )
+        events: list = []
+
+        def crash_between(e):
+            if e.phase == 1 and not events:
+                # crash the busiest transit switch of the phases so far
+                install_rows = controller.router.recovery.desired.flows
+                victim = max(
+                    (d for d in install_rows if d in controller.router.dps),
+                    key=lambda d: len(install_rows[d]),
+                )
+                fabric.crash_switch(victim)
+                events.append(("crash", victim, e.phase))
+
+        controller.bus.subscribe(
+            ev.EventCollectivePhaseInstalled, crash_between
+        )
+        kickoff(fabric, macs)
+        assert events, "the crash hook must have fired between phases"
+        victim = events[0][1]
+        # phases installed so far survive in the desired store for the
+        # dead switch (DesiredFlowStore survives EventDatapathDown by
+        # design); redial reconciles them back
+        down_rows = {
+            (src, dst)
+            for (src, dst) in controller.router.recovery.desired.flows.get(
+                victim, {}
+            )
+        }
+        assert down_rows, "phase-k rows on the victim must stay desired"
+        fabric.redial_switch(victim)
+        controller.router.recovery_tick()
+        assert installed_flows(fabric) == desired_flows(controller)
+        # and the program's own bookkeeping survived: one install, with
+        # its per-phase rows intact
+        table = list(controller.router.collectives)
+        assert len(table) == 1
+        assert table[0].n_phases > 0
+
+    def test_reap_failure_rolls_back_installed_phases(self, monkeypatch):
+        """A phase that FAILS mid-program (device reap error) must not
+        orphan the phases already shipped: their permanent rows are on
+        the switches and in the desired store, but no CollectiveInstall
+        exists yet — without rollback nothing could ever tear them
+        down, and every reconcile would re-drive them forever."""
+        from sdnmpi_tpu.sched.program import PhasePlan
+
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        phases: list = []
+        controller.bus.subscribe(
+            ev.EventCollectivePhaseInstalled, lambda e: phases.append(e)
+        )
+        orig = PhasePlan.reap
+
+        def boom(self):
+            if self.phase >= 1:
+                raise RuntimeError("device reap failed")
+            return orig(self)
+
+        monkeypatch.setattr(PhasePlan, "reap", boom)
+        # the bus logs handler exceptions instead of propagating them
+        # to the packet sender; the rollback postconditions are the
+        # contract under test
+        kickoff(fabric, macs)
+        # phase 0 really shipped before the failure...
+        assert [e.phase for e in phases] == [0]
+        # ...and the rollback swept it: no collective-flagged desired
+        # rows survive, no program is recorded, and the switch tables
+        # mirror the (collective-free) desired store exactly
+        assert not [
+            spec
+            for table in controller.router.recovery.desired.flows.values()
+            for spec in table.values()
+            if spec.collective
+        ]
+        assert len(controller.router.collectives) == 0
+        assert installed_flows(fabric) == desired_flows(controller)
+
+    @pytest.mark.parametrize("wire", [False, True])
+    def test_send_drop_soak_converges(self, wire):
+        """Seeded FaultPlan chaos over a scheduled install: spans drop
+        while the program installs, the bounded retries re-drive, and
+        after quiesce installed == desired exactly."""
+        import time as _time
+
+        fabric, controller, macs = make_stack(
+            wire=wire, schedule_collectives=True,
+        )
+        plan = FaultPlan(seed=22, p_send_drop=0.3).attach(fabric)
+        kickoff(fabric, macs)
+        plan.p_send_drop = 0.0  # chaos ends; anti-entropy converges
+        for _ in range(6):
+            controller.router.recovery_tick(_time.monotonic() + 10.0)
+        assert installed_flows(fabric) == desired_flows(controller)
+        assert desired_flows(controller), "the program must have installed"
+
+    def test_reconcile_skips_fdb_bookkeeping_for_phase_rows(self):
+        """Phase-scheduler rows reconcile like any desired row but carry
+        no SwitchFDB bookkeeping: a redial must not publish
+        EventFDBUpdate for them (the collective table owns their
+        lifecycle). FDB-owned rows — including a reactive flow
+        byte-identical to a phase row, which the store's first-writer-
+        wins rule keeps FDB-owned — DO republish theirs."""
+        fabric, controller, macs = make_stack(schedule_collectives=True)
+        kickoff(fabric, macs)
+        victim = max(
+            controller.router.recovery.desired.flows,
+            key=lambda d: len(controller.router.recovery.desired.flows[d]),
+        )
+        updates: list = []
+        controller.bus.subscribe(
+            ev.EventFDBUpdate, lambda e: updates.append(e)
+        )
+        fabric.crash_switch(victim)
+        fabric.redial_switch(victim)
+        controller.router.recovery_tick()
+        table = controller.router.recovery.desired.flows[victim]
+        for e in [u for u in updates if u.dpid == victim]:
+            spec = table.get((e.src, e.dst))
+            assert spec is not None and not spec.collective, (
+                "redial republished FDB bookkeeping for a "
+                "collective-owned phase row"
+            )
+        assert installed_flows(fabric) == desired_flows(controller)
+
+
+# -- per-phase hot-link attribution (satellite) ----------------------------
+
+
+class TestPhaseAttribution:
+    def test_congestion_report_names_the_phase_on_a_hot_link(self):
+        """The PR-7 attribution extended to phase grain: with a
+        scheduled install, the congestion report's collective entry
+        names the PHASE(S) whose routed blocks ride the hot link, and
+        the telemetry snapshot mirrors it."""
+        fabric, controller, macs = make_stack(
+            dag_flow_threshold=1, schedule_collectives=True,
+        )
+        kickoff(fabric, macs)
+        tm = controller.topology_manager
+        assert tm.util_plane is not None and tm.util_plane.bound
+        install = next(iter(controller.router.collectives))
+        assert install.phase_links
+        link, phases = sorted(install.phase_links.items())[0]
+        a, b = link
+        port = tm.topologydb.links[a][b].src.port_no
+        controller.bus.publish(
+            ev.EventPortStats(a, port, 0.0, 0.0, 0.0, 5e9)
+        )
+        controller.bus.publish(ev.EventStatsFlush())
+        report = controller.bus.request(ev.CongestionReportRequest()).report
+        assert report["collectives"], report
+        attributed = report["collectives"][0]
+        assert attributed["cookie"] == install.cookie
+        assert attributed["n_phases"] == install.n_phases
+        assert attributed["phases"] == list(phases)
+        snap = controller.telemetry()
+        assert snap["congestion"]["collectives"][0]["phases"] == list(phases)
+
+
+# -- stale congestion-gap gauge (satellite) --------------------------------
+
+
+class TestCongestionGaugeHygiene:
+    def test_policy_switch_clears_stale_fractional_gap(self):
+        """congestion_discrete_over_fractional described the LAST pass:
+        after a shortest-policy pass the DAG-balanced pass's fractional
+        bound and ratio must clear instead of pairing a stale bound
+        with a discrete figure it was never computed against."""
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        src, dst = alltoall_idx(N_RANKS)
+        oracle = db._jax_oracle()
+        oracle.routes_collective(db, macs, src, dst, "balanced")
+        assert oracle.last_fractional_congestion > 0
+        assert oracle.last_congestion_ratio > 0
+        oracle.routes_collective(db, macs, src, dst, "shortest")
+        assert oracle.last_fractional_congestion == 0.0
+        assert oracle.last_congestion_ratio == 0.0
+        assert REGISTRY.get("congestion_fractional_max").value == 0.0
+        assert (
+            REGISTRY.get("congestion_discrete_over_fractional").value == 0.0
+        )
+        # the discrete figure still describes the shortest pass
+        assert oracle.last_discrete_congestion > 0
+
+    def test_phase_batches_never_pair_with_the_flat_bound(self):
+        """A scheduled program's per-phase sub-batches compute no
+        fractional relaxation: reaping them must neither pair their
+        discrete maxima with the flat pass's bound (a cross-batch
+        ratio) nor clear the flat pass's live figures mid-program."""
+        spec = fattree(4)
+        db = spec.to_topology_db(backend="jax")
+        macs = sorted(m for m, _, _ in spec.hosts)[:N_RANKS]
+        src, dst = alltoall_idx(N_RANKS)
+        oracle = db._jax_oracle()
+        oracle.routes_collective(db, macs, src, dst, "balanced")
+        frac = oracle.last_fractional_congestion
+        disc = oracle.last_discrete_congestion
+        ratio = oracle.last_congestion_ratio
+        assert frac > 0 and disc > 0 and ratio > 0
+        prog = oracle.routes_collective_phased(db, macs, src, dst, "balanced")
+        assert prog.total_discrete_congestion() > 0
+        # ALL THREE figures still describe the flat pass as one
+        # consistent triple — a phase's discrete max beside the flat
+        # bound/ratio would be the cross-batch pairing in the report
+        assert oracle.last_discrete_congestion == disc
+        assert oracle.last_fractional_congestion == frac
+        assert oracle.last_congestion_ratio == ratio
+        assert REGISTRY.get("congestion_discrete_max").value == disc
+        assert REGISTRY.get("congestion_fractional_max").value == frac
