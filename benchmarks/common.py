@@ -110,6 +110,39 @@ def measure_route(route_fn, n_stream: int = 10, windows: int = ROUTE_WINDOWS):
     return ms, first, windows_ms
 
 
+def measure_route_serial(route_fn, n_stream: int = 10,
+                         windows: int = ROUTE_WINDOWS):
+    """:func:`measure_route` for MULTI-DEVICE programs: dispatches issue
+    from one thread, in order. The threaded pool variant deadlocks
+    sharded programs — two concurrent multi-device dispatches can grab
+    the devices' collective rendezvous in different orders and wait on
+    each other forever (observed on the CPU virtual mesh; the same
+    hazard exists on a real slice). JAX async dispatch still pipelines:
+    all n_stream programs are enqueued before the first blocking fetch,
+    so device compute and readback overlap exactly as the controller's
+    single dispatch thread would drive them."""
+    first = np.asarray(route_fn())
+    np.asarray(route_fn())
+    window_ms: list[float] = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        bufs = [route_fn() for _ in range(n_stream)]
+        for b in bufs:
+            try:
+                b.copy_to_host_async()
+            except Exception:
+                pass
+        for b in bufs:
+            np.asarray(b)
+        window_ms.append((time.perf_counter() - t0) / n_stream * 1e3)
+    log(
+        "serial stream windows (ms/item): "
+        + ", ".join(f"{t:.2f}" for t in window_ms)
+        + f" -> best {min(window_ms):.2f}"
+    )
+    return min(window_ms), first, window_ms
+
+
 def naive_single_path_load(adj_dev, dist_dev, usrc, udst, weight, max_len, v):
     """Max-link congestion of deterministic single-path routing — the
     vs_baseline denominator shared by the alltoall configs."""

@@ -1,0 +1,233 @@
+"""Config 13: pod-scale sharded oracle (sdnmpi_tpu/shardplane, ISSUE 9).
+
+Two datapoints:
+
+- **Primary**: 8192-rank MPI_Alltoall on a fat-tree k=56 (3,920
+  switches, padded to the mesh multiple — the ~4096-switch fabric of
+  the ROADMAP's pod-scale target). The collective routes through
+  ``route_collective_sharded`` over a mesh of every device the host
+  exposes (real chips on a slice; the XLA virtual CPU mesh otherwise —
+  the tpu_validate.sh smoke step runs it either way). vs_baseline:
+  max-link congestion of naive deterministic single-path routing / the
+  sharded balanced routing's congestion (the same quality ratio the
+  other alltoall configs report, so a shard-quality regression moves a
+  gated number).
+- **padding_tax twin**: the config-6b ceiling shape (fat-tree k=32, V
+  artificially padded to 2048) re-measured through the
+  occupancy-bucketed block kernels: the [V_occ, V_occ] occupied block
+  (1280 rows of the 2048 capacity) is what actually computes.
+  vs_baseline = old full-padded ms / new bucketed ms — the committed
+  gate pins the padding tax staying retired (>= ~1.6x here means the
+  2x tax of BASELINE config 6b is down to <= 1.25x).
+
+Reported value: steady-state per-collective route latency (pipelined
+stream, like bench.py). Both rows decode + validate the sampled paths
+at build time, so a silently-wrong sharded route fails the config
+instead of emitting a pretty number.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from benchmarks.common import (
+    alltoall_problem,
+    emit,
+    log,
+    measure_route,
+    measure_route_serial,
+    naive_single_path_load,
+)
+
+N_RANKS = 8192
+K_PRIMARY = 56
+K_TAX = 32
+V_TAX_PAD = 2048
+#: occupancy bucket of the tax twin (lane width — the engine default)
+OCC_MULTIPLE = 128
+
+
+def pick_mesh_devices(requested: int = 0) -> int:
+    """Largest power-of-two device count this host can mesh (the mesh
+    factory wants an even split; pow2 also divides every lane-multiple
+    padded V). ``requested`` > 0 clamps."""
+    from sdnmpi_tpu.shardplane import host_shard_devices
+
+    have = host_shard_devices(requested)
+    n = 1
+    while n * 2 <= have:
+        n *= 2
+    return n
+
+
+def build(k: int, pad_multiple: int, n_ranks: int, mesh_devices: int):
+    """Tensorized alltoall problem + sharded/single-chip kernel args at
+    one shape — shared by the bench rows and the test-scale fence
+    (tests/test_shard_bench.py)."""
+    import jax
+
+    from sdnmpi_tpu.oracle.apsp import apsp_distances
+    from sdnmpi_tpu.oracle.dag import make_dst_nodes
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    db = spec.to_topology_db(backend="jax", pad_multiple=pad_multiple)
+    t = tensorize(db, pad_multiple=pad_multiple)
+    v = t.adj.shape[0]
+    if v % mesh_devices:
+        raise ValueError(f"V={v} must divide by {mesh_devices} devices")
+    adj = np.asarray(t.adj)
+
+    usrc, udst, weight, n_rank_pairs = alltoall_problem(spec, t, n_ranks)
+    pad = (-len(usrc)) % mesh_devices
+    if pad:
+        usrc = np.concatenate([usrc, np.full(pad, -1, np.int32)])
+        udst = np.concatenate([udst, np.full(pad, -1, np.int32)])
+        weight = np.concatenate([weight, np.zeros(pad, np.float32)])
+    live = usrc >= 0
+
+    dst_nodes = make_dst_nodes(udst[live])
+    dist_d = apsp_distances(t.adj)
+    dist_h = np.asarray(dist_d)
+    levels = int(np.nanmax(np.where(np.isfinite(dist_h), dist_h, np.nan)))
+    li, lj = (a.astype(np.int32) for a in np.nonzero(adj > 0))
+    util = np.zeros(len(li), np.float32)  # idle fabric: exact parity
+    traffic = np.zeros((v, v), np.float32)
+    np.add.at(traffic, (udst[live], usrc[live]), weight[live])
+
+    args = [
+        t.adj, jax.device_put(li), jax.device_put(lj), jax.device_put(util),
+        jax.device_put(traffic), jax.device_put(usrc), jax.device_put(udst),
+    ]
+    kw = dict(levels=levels, rounds=2, max_len=levels + 1, dist=dist_d)
+    use_dn = len(dst_nodes) < v and len(dst_nodes) % mesh_devices == 0
+    if use_dn:
+        kw["dst_nodes"] = jax.device_put(np.asarray(dst_nodes))
+    return spec, t, args, kw, usrc, udst, weight, n_rank_pairs
+
+
+def occ_args(t, args, kw, v_occ: int):
+    """The same problem sliced to the occupied bucket — what the engine
+    routes when occupancy bucketing engages (``_occ_block``)."""
+    import jax.numpy as jnp
+
+    adj, li, lj, util, traffic, usrc, udst = args
+    sliced = [
+        adj[:v_occ, :v_occ], li, lj, util,
+        traffic[:v_occ, :v_occ], usrc, udst,
+    ]
+    kw2 = dict(kw)
+    kw2["dist"] = jnp.asarray(kw["dist"])[:v_occ, :v_occ]
+    return sliced, kw2
+
+
+def validate(t, usrc, udst, slots) -> None:
+    """Every live flow's decoded path must run src -> dst over real
+    links — the is-it-actually-routing check both rows pass through."""
+    from sdnmpi_tpu.oracle.dag import slots_to_nodes
+
+    adj = np.asarray(t.adj)
+    nodes = slots_to_nodes(adj, usrc, slots, dst=udst, complete=True)
+    live = np.nonzero(usrc >= 0)[0]
+    sample = live[:: max(1, len(live) // 512)]  # spot-check, O(512) host work
+    for f in sample:
+        p = nodes[f][nodes[f] >= 0]
+        assert p[0] == usrc[f] and p[-1] == udst[f], f"flow {f}: {p}"
+        assert (adj[p[:-1], p[1:]] > 0).all(), f"flow {f} rides a non-link"
+
+
+def main() -> None:
+    import math
+
+    from benchmarks.common import init_backend
+
+    init_backend()
+    from sdnmpi_tpu.oracle.adaptive import link_loads
+    from sdnmpi_tpu.oracle.dag import (
+        route_collective,
+        sampled_hops,
+        slots_to_nodes,
+        unpack_result,
+    )
+    from sdnmpi_tpu.shardplane import make_mesh, route_collective_sharded
+
+    n_mesh = pick_mesh_devices()
+    mesh = make_mesh(n_mesh)
+
+    # -- primary: the pod-scale target shape over the mesh ----------------
+    pad = math.lcm(128, n_mesh)
+    spec, t, args, kw, usrc, udst, weight, n_rank_pairs = build(
+        K_PRIMARY, pad, N_RANKS, n_mesh
+    )
+    v = t.adj.shape[0]
+    log(f"fattree k={K_PRIMARY}: {spec.n_switches} switches (padded {v}), "
+        f"alltoall {n_rank_pairs:,} rank pairs -> {len(usrc):,} edge flows, "
+        f"mesh devices {n_mesh}")
+
+    def route_sharded():
+        slots, _ = route_collective_sharded(*args, mesh=mesh, **kw)
+        return slots
+
+    # serial stream: concurrent multi-device dispatches deadlock the
+    # collective rendezvous (see measure_route_serial)
+    t_ms, slots_first, windows = measure_route_serial(route_sharded)
+    validate(t, usrc, udst, slots_first)
+    live = usrc >= 0
+    load = link_loads(
+        slots_to_nodes(
+            np.asarray(t.adj), usrc, np.asarray(slots_first), dst=udst,
+            complete=True,
+        ),
+        weight, v,
+    )
+    naive_load = naive_single_path_load(
+        t.adj, kw["dist"], usrc[live], udst[live], weight[live],
+        kw["max_len"], v,
+    )
+    log(f"sharded route {t_ms:.2f} ms; congestion {load.max():,.0f} vs "
+        f"single-path {naive_load.max():,.0f}")
+    emit(
+        "alltoall8192_fattree4096_shard_route_ms", t_ms, "ms",
+        naive_load.max() / max(load.max(), 1.0), windows_ms=windows,
+        mesh_devices=n_mesh,
+    )
+
+    # -- padding_tax twin: config-6b shape through the bucketed kernels ---
+    spec2, t2, args2, kw2, usrc2, udst2, _, _ = build(K_TAX, V_TAX_PAD, N_RANKS, 1)
+    from sdnmpi_tpu.oracle.apsp import occ_bucket
+
+    v_occ = occ_bucket(t2.n_real, t2.adj.shape[0], OCC_MULTIPLE)
+    log(f"padding tax twin: k={K_TAX} padded {t2.n_real} -> "
+        f"{t2.adj.shape[0]}, occupied bucket {v_occ}")
+    args_occ, kw_occ = occ_args(t2, args2, kw2, v_occ)
+
+    def _measure(a, k):
+        max_len = k["max_len"]
+
+        def route():
+            buf = route_collective(
+                a[0], a[1], a[2], a[3], a[4], a[5], a[6],
+                max_degree=t2.max_degree, **k,
+            )
+            return buf
+
+        ms, buf, w = measure_route(route)
+        slots, _ = unpack_result(np.asarray(buf), len(usrc2), max_len)
+        assert slots.shape[1] == sampled_hops(max_len)
+        return ms, slots, w
+
+    t_pad_ms, slots_pad, _ = _measure(args2, kw2)
+    t_occ_ms, slots_occ, windows_occ = _measure(args_occ, kw_occ)
+    np.testing.assert_array_equal(slots_occ, slots_pad)  # the fence
+    validate(t2, usrc2, udst2, slots_occ)
+    log(f"padded {t_pad_ms:.2f} ms vs bucketed {t_occ_ms:.2f} ms "
+        f"({t_pad_ms / t_occ_ms:.2f}x)")
+    emit(
+        "alltoall8192_v2048pad_bucketed_route_ms", t_occ_ms, "ms",
+        t_pad_ms / t_occ_ms, windows_ms=windows_occ, v_occ=v_occ,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ CONFIGS = [
     ("10", [sys.executable, "-m", "benchmarks.config10_pipeline"]),
     ("11", [sys.executable, "-m", "benchmarks.config11_recovery"]),
     ("12", [sys.executable, "-m", "benchmarks.config12_schedule"]),
+    ("13", [sys.executable, "-m", "benchmarks.config13_shard"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

@@ -6,5 +6,9 @@ set -x
 SDNMPI_TEST_TPU=1 timeout 1200 python -m pytest tests/test_kernels_tpu.py -q || exit 1
 timeout 900 python bench.py || exit 2
 timeout 1800 python -m benchmarks.run 6 7 || exit 3
+# mesh smoke: the sharded oracle leg (config 13 sizes its mesh to
+# whatever the host exposes — real chips here, the virtual CPU mesh on
+# a dev box — so the shardplane program runs on every validation pass)
+timeout 1800 python -m benchmarks.run 13 || exit 4
 timeout 900 python -m benchmarks.profile_stages fattree:32 128 || true
 timeout 900 python -m benchmarks.profile_stages torus:6,6,6 128 || true

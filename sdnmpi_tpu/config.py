@@ -97,15 +97,27 @@ class Config:
     #: congestion-reweighting rounds of the DAG balancer
     balance_rounds: int = 2
     #: shard the flagship DAG balancer + sampler over the first N local
-    #: devices (parallel/mesh.route_collective_sharded): the traffic's
+    #: devices (shardplane.route_collective_sharded): the traffic's
     #: destination axis and the flow batch split across the mesh with
     #: one psum per balance round. 0 = single-device. Hash streams are
     #: keyed by global flow id, so sampled paths match the single-device
     #: engine exactly when link loads sum exactly in f32 (idle fabrics,
     #: dyadic splits); under measured utilization the psum's reduction
     #: order can differ by ulps from the single-device matmul, which may
-    #: flip a near-tied Gumbel choice (see parallel/mesh.py contract).
+    #: flip a near-tied Gumbel choice (see shardplane/routes.py contract).
     mesh_devices: int = 0
+    #: promote the mesh from a DAG-engine accelerator to the
+    #: FULL pod-scale sharded oracle backend (sdnmpi_tpu/shardplane,
+    #: ISSUE 9): the refresh's APSP distance AND next-hop tensors
+    #: row-shard across every device of the ``mesh_devices`` mesh, and
+    #: the shortest-path window extraction joins the balanced/adaptive/
+    #: collective legs in partitioning its flow batch over the mesh —
+    #: with per-host readback staying packed (compact WindowRoutes
+    #: struct arrays, never an [F, V] gather). Requires
+    #: ``mesh_devices`` > 0 (ignored with a warning otherwise). Default
+    #: OFF: the single-chip oracle path is byte-identical to the
+    #: pre-shardplane controller (pinned by tests/test_shardplane.py).
+    shard_oracle: bool = False
     #: rank-pair count at or above which a proactive collective install
     #: uses the array-native block path (int MAC keys, shared
     #: FlowPathBlocks, one event per collective) instead of the

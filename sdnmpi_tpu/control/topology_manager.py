@@ -63,6 +63,7 @@ class TopologyManager:
             pad_multiple=config.switch_pad_multiple,
             max_diameter=config.max_diameter,
             mesh_devices=config.mesh_devices,
+            shard_oracle=config.shard_oracle,
             delta_repair_threshold=config.delta_repair_threshold,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed

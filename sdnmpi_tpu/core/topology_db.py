@@ -119,6 +119,7 @@ class TopologyDB:
         pad_multiple: int = 8,
         max_diameter: int = 0,
         mesh_devices: int = 0,
+        shard_oracle: bool = False,
         delta_repair_threshold: Optional[int] = None,
     ) -> None:
         # dpid -> switch entity
@@ -132,6 +133,11 @@ class TopologyDB:
         self.pad_multiple = pad_multiple
         self.max_diameter = max_diameter
         self.mesh_devices = mesh_devices
+        #: full shardplane oracle backend (Config.shard_oracle, ISSUE 9):
+        #: APSP next hops and the shortest-path window extraction shard
+        #: over the mesh_devices mesh alongside the balanced/adaptive
+        #: legs; False keeps the single-chip oracle byte-identical
+        self.shard_oracle = shard_oracle
         #: max link deltas the oracle absorbs by in-place repair before
         #: a full recompute (None = RouteOracle's default; 0 disables)
         self.delta_repair_threshold = delta_repair_threshold
@@ -627,6 +633,7 @@ class TopologyDB:
             self._oracle = RouteOracle(
                 self.pad_multiple, self.max_diameter,
                 mesh_devices=self.mesh_devices,
+                shard_oracle=self.shard_oracle,
             )
             if self.delta_repair_threshold is not None:
                 self._oracle.delta_repair_threshold = (

@@ -122,6 +122,7 @@ def config_from_args(args) -> Config:
         flow_idle_timeout=args.flow_idle_timeout,
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
+        shard_oracle=getattr(args, "shard_oracle", False),
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
@@ -373,6 +374,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh-devices", type=int, default=0,
         help="shard the DAG balancer over the first N local devices "
         "(0 = single-device)",
+    )
+    parser.add_argument(
+        "--shard-oracle", action="store_true",
+        help="promote --mesh-devices to the FULL pod-scale sharded "
+        "oracle backend (sdnmpi_tpu/shardplane): APSP distances + next "
+        "hops row-shard over the mesh and every routing entry point "
+        "partitions its flow batch across it, with packed per-host "
+        "readback. Bit-identical routes; requires --mesh-devices N > 0",
     )
     parser.add_argument(
         "--no-recovery", action="store_true",

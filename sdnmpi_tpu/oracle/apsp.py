@@ -31,23 +31,28 @@ from jax import lax
 INF = jnp.inf
 
 
-@functools.partial(jax.jit, static_argnames=("max_diameter",))
-def apsp_distances(adj: jax.Array, max_diameter: int = 0) -> jax.Array:
-    """Hop-count distance matrix ``[V, V]`` (f32, inf = unreachable).
+def occ_bucket(n_real: int, v: int, multiple: int = 128) -> int:
+    """Occupied-row bucket of a padded ``[V, V]`` fabric: ``n_real``
+    rounded up to ``multiple`` (lane-width by default), capped at V.
+    The occupancy-bucketed kernels compute only this many rows/columns
+    and fill the padding block analytically — the jit ladder is bounded
+    because occupancy only re-traces when it crosses a bucket edge.
+    Returns V (occupancy off) when the bucket would not actually shrink
+    the computed block."""
+    if n_real <= 0 or multiple <= 0:
+        return v
+    b = ((n_real + multiple - 1) // multiple) * multiple
+    return v if b >= v else b
 
-    ``adj[i, j]`` nonzero iff a directed link i -> j exists. Rows are
-    sources. Runs BFS frontier expansion as f32 matmuls under a
-    ``while_loop`` that exits as soon as no new vertex is reached, so the
-    iteration count is the graph diameter, not V. ``max_diameter`` > 0
-    additionally caps the iteration count (Config.max_diameter); paths
-    longer than the cap are reported unreachable.
-    """
-    v = adj.shape[0]
-    bound = min(v, max_diameter) if max_diameter > 0 else v
-    a = (adj > 0).astype(jnp.float32)
-    eye = jnp.eye(v, dtype=jnp.float32)
-    reached0 = eye
-    dist0 = jnp.where(eye > 0, 0.0, INF)
+
+def _bfs_rows(a, reached0, dist0, bound):
+    """BFS frontier expansion for a block of source rows — THE loop body
+    of multi-source APSP, shared by :func:`apsp_distances` and the
+    shardplane's row-sharded kernel (shardplane/apsp.py) so the sharded
+    distances can never drift from the single-chip ones. ``a`` must
+    already be the 0/1 f32 adjacency; each step grows every row's
+    frontier with one ``[R, V] @ [V, V]`` matmul, clamped to {0, 1} so
+    values stay exact in f32 regardless of walk counts."""
 
     def cond(carry):
         _, _, t, changed = carry
@@ -55,8 +60,6 @@ def apsp_distances(adj: jax.Array, max_diameter: int = 0) -> jax.Array:
 
     def body(carry):
         reached, dist, t, _ = carry
-        # one BFS step for every source row at once; clamp to {0, 1} so
-        # values stay exact in f32 regardless of walk counts
         grown = jnp.minimum(reached @ a + reached, 1.0)
         newly = (grown > 0) & jnp.isinf(dist)
         dist = jnp.where(newly, t.astype(jnp.float32), dist)
@@ -66,6 +69,43 @@ def apsp_distances(adj: jax.Array, max_diameter: int = 0) -> jax.Array:
         cond, body, (reached0, dist0, jnp.int32(1), jnp.bool_(True))
     )
     return dist
+
+
+@functools.partial(jax.jit, static_argnames=("max_diameter", "n_occ"))
+def apsp_distances(
+    adj: jax.Array, max_diameter: int = 0, n_occ: int = 0
+) -> jax.Array:
+    """Hop-count distance matrix ``[V, V]`` (f32, inf = unreachable).
+
+    ``adj[i, j]`` nonzero iff a directed link i -> j exists. Rows are
+    sources. Runs BFS frontier expansion as f32 matmuls under a
+    ``while_loop`` that exits as soon as no new vertex is reached, so the
+    iteration count is the graph diameter, not V. ``max_diameter`` > 0
+    additionally caps the iteration count (Config.max_diameter); paths
+    longer than the cap are reported unreachable.
+
+    ``n_occ`` > 0 (a static occupied-row bucket, see :func:`occ_bucket`)
+    restricts the frontier block to the first ``n_occ`` source rows —
+    the occupancy-bucketed form (ISSUE 9): tensorize assigns real nodes
+    the low indices, so rows past the bucket are pure padding whose BFS
+    is analytic (self only). A 2048-padded fabric holding 1280 occupied
+    rows then pays ``[1280, V] @ [V, V]`` per step instead of the full
+    square — bit-identical output, pinned by tests/test_shardplane.py.
+    """
+    v = adj.shape[0]
+    bound = min(v, max_diameter) if max_diameter > 0 else v
+    n_rows = v if n_occ <= 0 else min(v, n_occ)
+    a = (adj > 0).astype(jnp.float32)
+    eye = jnp.eye(v, dtype=jnp.float32)
+    reached0 = eye[:n_rows]
+    dist0 = jnp.where(reached0 > 0, 0.0, INF)
+    dist = _bfs_rows(a, reached0, dist0, bound)
+    if n_rows == v:
+        return dist
+    # padding rows have no out-links: distance is 0 to self, inf
+    # elsewhere — exactly what the full BFS computes for them
+    pad = jnp.where(eye[n_rows:] > 0, 0.0, INF)
+    return jnp.concatenate([dist, pad], axis=0)
 
 
 def _fit_block(v: int, per_col_floats: int) -> int:
@@ -107,9 +147,10 @@ def _degree_compact_block(
     return jnp.take_along_axis(safe, k, axis=1)  # [V, B]
 
 
-@functools.partial(jax.jit, static_argnames=("block", "max_degree"))
+@functools.partial(jax.jit, static_argnames=("block", "max_degree", "n_occ"))
 def apsp_next_hops(
-    adj: jax.Array, dist: jax.Array, block: int = 0, max_degree: int = 0
+    adj: jax.Array, dist: jax.Array, block: int = 0, max_degree: int = 0,
+    n_occ: int = 0,
 ) -> jax.Array:
     """Next-hop matrix ``[V, V]`` int32: ``next_hop[i, j]`` is the first
     switch after ``i`` on the chosen shortest path to ``j``; ``i`` on the
@@ -127,22 +168,34 @@ def apsp_next_hops(
 
     Destination columns are processed in blocks to bound the broadcast
     intermediate at ~256 MB regardless of V.
+
+    ``n_occ`` > 0 (static occupied bucket, :func:`occ_bucket`) restricts
+    the computed block to the occupied ``[n_occ, n_occ]`` corner on the
+    degree-compact path; padding rows/columns are analytic (-1 off the
+    diagonal: their distances are inf) and come out of the shared final
+    masking identically to the full computation. The dense
+    ``max_degree=0`` path ignores it (it is the differential reference
+    and must stay literally the textbook form).
     """
     v = adj.shape[0]
     adj_mask = adj > 0
+    n_rows = n_cols = v
 
     if max_degree > 0:
         # single source of the sorted-neighbor construction (its
         # lowest-dpid tie-break is load-bearing for reference parity)
         from sdnmpi_tpu.oracle.dag import neighbor_table
 
+        if n_occ > 0:
+            n_rows = n_cols = min(v, n_occ)
         d = min(max_degree, v)
         _, valid, safe = neighbor_table(adj, max_degree)
+        valid, safe = valid[:n_rows], safe[:n_rows]
 
         def per_block(db):  # db: [B, V] rows = destinations
             return _degree_compact_block(valid, safe, db.T)
 
-        per_col_floats = v * d
+        per_col_floats = n_rows * d
     else:
 
         def per_block(db):
@@ -150,13 +203,16 @@ def apsp_next_hops(
 
         per_col_floats = v * v
 
+    cols = dist.T[:n_cols]  # [n_cols, V] rows = occupied destinations
     if block == 0:
-        block = _fit_block(v, per_col_floats)
-    if block == v:
-        nxt = per_block(dist.T)
+        block = _fit_block(n_cols, per_col_floats)
+    if block == n_cols:
+        nxt = per_block(cols)
     else:
-        blocks = lax.map(per_block, dist.T.reshape(v // block, block, v))
-        nxt = jnp.moveaxis(blocks, 0, 1).reshape(v, v)
+        blocks = lax.map(per_block, cols.reshape(n_cols // block, block, v))
+        nxt = jnp.moveaxis(blocks, 0, 1).reshape(n_rows, n_cols)
+    if n_rows < v or n_cols < v:
+        nxt = jnp.zeros((v, v), jnp.int32).at[:n_rows, :n_cols].set(nxt)
 
     idx = jnp.arange(v, dtype=jnp.int32)
     nxt = jnp.where(jnp.isinf(dist), -1, nxt)

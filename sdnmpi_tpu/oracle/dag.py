@@ -143,7 +143,7 @@ def restrict_dst(
     The one device-side encoding of the dst_nodes pad convention (-1 =
     pad; padded rows get inf distance so no level mask ever matches, and
     zero traffic) — shared by ``balance_rounds`` and the sharded engine
-    (parallel/mesh.py) so the two paths cannot desynchronize.
+    (shardplane/routes.py) so the two paths cannot desynchronize.
     """
     valid = (dst_nodes >= 0)[:, None]
     rows = jnp.maximum(dst_nodes, 0)
@@ -321,7 +321,7 @@ def sample_paths_dense(
 
     iota = jnp.arange(v, dtype=jnp.int32)
     # fid_base shifts flow ids to their *global* batch index so a sharded
-    # caller (parallel/mesh.py) draws the same noise stream per flow as
+    # caller (shardplane/routes.py) draws the same noise stream per flow as
     # the single-device path — bit-identical sampled paths
     fid = jnp.arange(f, dtype=jnp.uint32) + jnp.asarray(fid_base).astype(jnp.uint32)
     alive0 = (src >= 0) & (dst >= 0) & member

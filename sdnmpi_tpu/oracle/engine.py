@@ -10,6 +10,7 @@ entire collective's batch — is resolved against the cached matrices.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import TYPE_CHECKING, Optional
@@ -54,6 +55,24 @@ _m_congestion_ratio = REGISTRY.gauge(
     "discrete / fractional max-congestion of the last DAG-balanced pass "
     "(1.0 = sampling achieved the bound; the gap is scheduling headroom)",
 )
+# pod-scale shardplane (ISSUE 9): wall time of the sharded legs, split
+# by pipeline phase — dispatch (program enqueue; host work only, async
+# device compute behind it) and reap (the blocking transfer + decode of
+# one window). A p99 spike in either attributes to the sharded leg via
+# the shard_dispatch child span each dispatch opens under the Router's
+# route_window span.
+_m_shard_dispatch_s = REGISTRY.histogram(
+    "shard_dispatch_seconds",
+    help="sharded-oracle window dispatch (program enqueue) wall seconds",
+)
+_m_shard_reap_s = REGISTRY.histogram(
+    "shard_reap_seconds",
+    help="sharded-oracle window reap (transfer + host decode) wall seconds",
+)
+_m_shard_mesh = REGISTRY.gauge(
+    "shard_mesh_devices",
+    "devices of the oracle's shardplane mesh (0 = single-chip)",
+)
 
 
 @jax.jit
@@ -88,6 +107,20 @@ def _touched_rows(nodes, mask):
     count_trace("delta_touched")
     safe = jnp.maximum(nodes, 0)
     return ((nodes >= 0) & mask[safe]).any(axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _occ_block(x, n):
+    """Leading ``[n, n]`` block of a device-resident ``[V, V]`` tensor —
+    the occupancy-bucketed view (ISSUE 9): real switches occupy the low
+    indices (tensorize assigns sorted-dpid order, padding above), so the
+    block kernels can run on this slice and skip the padding capacity
+    entirely. ``n`` is bucketed (occ_bucket), so the jit ladder is one
+    trace per bucket edge, not one per occupancy count."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("occ_block")
+    return x[:n, :n]
 
 
 @jax.jit
@@ -308,7 +341,15 @@ class RouteOracle:
         pad_multiple: int = 8,
         max_diameter: int = 0,
         mesh_devices: int = 0,
+        shard_oracle: bool = False,
     ) -> None:
+        if shard_oracle and not mesh_devices:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "shard_oracle needs mesh_devices > 0; staying single-chip"
+            )
+            shard_oracle = False
         if mesh_devices:
             import jax
 
@@ -333,6 +374,11 @@ class RouteOracle:
         self.pad_multiple = pad_multiple
         self.max_diameter = max_diameter
         self.mesh_devices = mesh_devices
+        #: full shardplane backend (ISSUE 9): sharded next hops + the
+        #: flow-sharded shortest-path window extraction join the
+        #: mesh-sharded balanced/adaptive/collective legs. Only
+        #: meaningful with mesh_devices > 0 (validated above).
+        self.shard_oracle = shard_oracle and mesh_devices > 0
         self._mesh = None  # lazily-built jax.sharding.Mesh
         self._version: Optional[int] = None
         self._tensors: Optional[TopoTensors] = None
@@ -370,6 +416,31 @@ class RouteOracle:
 
     delta_repair_threshold: int = _DEFAULTS.delta_repair_threshold
     del _DEFAULTS
+
+    #: occupancy-bucket width of the block kernels (ISSUE 9): when the
+    #: padded capacity V exceeds the occupied switch count by at least
+    #: one bucket of this many rows, the APSP kernels and the DAG
+    #: collective engine compute only the occupied block (the padding
+    #: block is analytic) — retiring the config-6b padding tax. 128
+    #: (the lane width) bounds the jit ladder to one trace per bucket
+    #: edge crossed; 0 disables bucketing (full-capacity kernels, the
+    #: pre-ISSUE-9 shapes). Results are bit-identical either way
+    #: (tests/test_shardplane.py).
+    occ_bucket_multiple: int = 128
+
+    def _occ_v(self, t: TopoTensors) -> int:
+        """Occupied-bucket V of this topology version (== t.v when
+        bucketing is off or would not shrink the computed block). The
+        shardplane mesh additionally needs the bucket to divide by the
+        device count, so the bucket width is lifted to the lcm there."""
+        from sdnmpi_tpu.oracle.apsp import occ_bucket
+
+        mult = self.occ_bucket_multiple
+        if mult and self.mesh_devices:
+            import math
+
+            mult = math.lcm(mult, self.mesh_devices)
+        return occ_bucket(t.n_real, t.v, mult)
 
     # -- cache management -------------------------------------------------
 
@@ -436,7 +507,29 @@ class RouteOracle:
 
                 tensors = tensorize(db, self.pad_multiple)
                 mesh = self._dag_mesh()
+                v_occ = self._occ_v(tensors)
+                n_occ = 0 if v_occ >= tensors.v else v_occ
                 if (
+                    self.shard_oracle
+                    and mesh is not None
+                    and self.max_diameter == 0  # sharded BFS has no cap
+                    and tensors.v % self.mesh_devices == 0
+                ):
+                    # shardplane refresh (ISSUE 9): BFS sources AND
+                    # next-hop rows block-shard over EVERY mesh device
+                    # (the prototype's "v"-axis BFS used only that
+                    # sub-axis); occupied-column bucketing rides along
+                    from sdnmpi_tpu.shardplane import (
+                        apsp_distances_rowsharded,
+                        apsp_next_hops_rowsharded,
+                    )
+
+                    dist = apsp_distances_rowsharded(tensors.adj, mesh)
+                    nxt = apsp_next_hops_rowsharded(
+                        tensors.adj, dist, mesh, tensors.max_degree,
+                        n_occ=n_occ,
+                    )
+                elif (
                     mesh is not None
                     and self.max_diameter == 0  # sharded BFS has no cap
                     and mesh.shape["v"] > 1  # v=1 would just replicate
@@ -445,14 +538,21 @@ class RouteOracle:
                     # multi-chip refresh: the APSP (the refresh's device
                     # cost) row-shards over the mesh's "v" axis, so
                     # topology churn recovers at mesh scale too
-                    from sdnmpi_tpu.parallel.mesh import apsp_distances_sharded
+                    from sdnmpi_tpu.shardplane import apsp_distances_sharded
 
                     dist = apsp_distances_sharded(tensors.adj, mesh)
+                    nxt = apsp_next_hops(
+                        tensors.adj, dist, max_degree=tensors.max_degree,
+                        n_occ=n_occ,
+                    )
                 else:
-                    dist = apsp_distances(tensors.adj, self.max_diameter)
-                nxt = apsp_next_hops(
-                    tensors.adj, dist, max_degree=tensors.max_degree
-                )
+                    dist = apsp_distances(
+                        tensors.adj, self.max_diameter, n_occ=n_occ
+                    )
+                    nxt = apsp_next_hops(
+                        tensors.adj, dist, max_degree=tensors.max_degree,
+                        n_occ=n_occ,
+                    )
                 self._tensors = tensors
                 self._dist_d = dist  # stays on device for route_collective
                 self._next_d = nxt
@@ -1010,17 +1110,41 @@ class RouteOracle:
         # flap-burst sizes vary freely per delta, so the delta path
         # buckets at the coarse pow2 tier: one compile per power of two
         # for the whole storm instead of one per multiple-of-8 length
+        shard_mesh = self._shard_mesh()
+        mult = 8
+        if shard_mesh is not None:
+            import math
+
+            # shard-count-divisible buckets: the flow axis partitions
+            # across every mesh device (pow2 tiers of an lcm floor stay
+            # divisible, so the delta path's coarse buckets survive)
+            mult = math.lcm(8, self.mesh_devices)
         src_p, dst_p, fport_p = pad_flow_batch(
-            src_idx, dst_idx, final_port, pow2=_dirty is not None
+            src_idx, dst_idx, final_port, multiple=mult,
+            pow2=_dirty is not None,
         )
-        nodes_d, ports_d, length_d = batch_fdb(
-            self._next_d,
-            t.port,
-            jnp.asarray(src_p),
-            jnp.asarray(dst_p),
-            jnp.asarray(fport_p),
-            max_len,
-        )
+        if shard_mesh is not None:
+            from sdnmpi_tpu.shardplane import batch_fdb_sharded
+
+            with self._shard_dispatch_scope(len(src_p)):
+                nodes_d, ports_d, length_d = batch_fdb_sharded(
+                    self._next_d,
+                    t.port,
+                    jnp.asarray(src_p),
+                    jnp.asarray(dst_p),
+                    jnp.asarray(fport_p),
+                    max_len,
+                    shard_mesh,
+                )
+        else:
+            nodes_d, ports_d, length_d = batch_fdb(
+                self._next_d,
+                t.port,
+                jnp.asarray(src_p),
+                jnp.asarray(dst_p),
+                jnp.asarray(fport_p),
+                max_len,
+            )
         touched_d = None
         if _dirty is not None:
             # dirty set as a [V] bool mask tensor: the per-pair
@@ -1069,7 +1193,9 @@ class RouteOracle:
                 wr.touched = touched
             return wr
 
-        return RouteWindow(reap)
+        return RouteWindow(
+            self._shard_timed_reap(reap) if shard_mesh is not None else reap
+        )
 
     #: sub-flow count at or above which balanced batches route through
     #: the level-decomposed MXU balancer + fused sampler
@@ -1122,7 +1248,7 @@ class RouteOracle:
         contract as the greedy scanner's output.
 
         With ``mesh_devices`` configured, the same program runs sharded
-        over the device mesh (parallel/mesh.route_collective_sharded),
+        over the device mesh (shardplane.route_collective_sharded),
         one psum per balance round; sampled slots match single-device
         exactly when loads sum exactly in f32 (see Config.mesh_devices
         for the ulp caveat under measured utilization)."""
@@ -1139,29 +1265,44 @@ class RouteOracle:
             util = _gather_links(base, jnp.asarray(li), jnp.asarray(lj))
         else:
             util = np.ascontiguousarray(base[li, lj], dtype=np.float32)
-        traffic = np.zeros((t.v, t.v), np.float32)
+        # occupancy-bucketed block view (ISSUE 9): a padded fabric whose
+        # capacity exceeds the occupied switch count by a bucket routes
+        # on the [v_occ, v_occ] slice — every flow endpoint and link
+        # index is below n_real, so the balancer/sampler inputs are the
+        # same values and the slots come out bit-identical, at the
+        # occupied shape's compute cost (the config-6b padding tax)
+        v_eff = self._occ_v(t)
+        if v_eff < t.v:
+            adj_eff = _occ_block(t.adj, v_eff)
+            dist_eff = _occ_block(self._dist_d, v_eff)
+        else:
+            adj_eff, dist_eff = t.adj, self._dist_d
+        traffic = np.zeros((v_eff, v_eff), np.float32)
         np.add.at(traffic, (dst_idx, src_idx), sub_w)
 
         mesh = self._dag_mesh()
-        if mesh is not None and t.v % self.mesh_devices == 0:
+        if mesh is not None and v_eff % self.mesh_devices == 0:
             from sdnmpi_tpu.oracle.dag import make_dst_nodes, sampled_hops
-            from sdnmpi_tpu.parallel.mesh import route_collective_sharded
+            from sdnmpi_tpu.shardplane import route_collective_sharded
 
             src_p, dst_p, _ = self._pad_flows(src_idx, dst_idx)
             dn = make_dst_nodes(dst_idx)  # 128-multiple: divides the mesh
             # restriction only pays when T is actually smaller than V
             # (the pad floor is 128) and T divides the mesh
-            use_dn = len(dn) < t.v and len(dn) % self.mesh_devices == 0
-            slots_d, _maxc = route_collective_sharded(
-                t.adj, jnp.asarray(li), jnp.asarray(lj), jnp.asarray(util),
-                jnp.asarray(traffic), jnp.asarray(src_p), jnp.asarray(dst_p),
-                mesh, levels=max_len - 1, rounds=rounds, max_len=max_len,
-                dist=self._dist_d,
-                dst_nodes=jnp.asarray(dn) if use_dn else None,
-            )
-            assert slots_d.shape[1] == sampled_hops(max_len)
-            _start_host_copy(slots_d)
+            use_dn = len(dn) < v_eff and len(dn) % self.mesh_devices == 0
+            with self._shard_dispatch_scope(len(src_p)):
+                slots_d, _maxc = route_collective_sharded(
+                    adj_eff, jnp.asarray(li), jnp.asarray(lj),
+                    jnp.asarray(util), jnp.asarray(traffic),
+                    jnp.asarray(src_p), jnp.asarray(dst_p),
+                    mesh, levels=max_len - 1, rounds=rounds,
+                    max_len=max_len, dist=dist_eff,
+                    dst_nodes=jnp.asarray(dn) if use_dn else None,
+                )
+                assert slots_d.shape[1] == sampled_hops(max_len)
+                _start_host_copy(slots_d)
 
+            @self._shard_timed_reap
             def reap_sharded() -> np.ndarray:
                 self.last_fractional_congestion = float(np.asarray(_maxc))
                 _m_frac_congestion.set(self.last_fractional_congestion)
@@ -1188,7 +1329,7 @@ class RouteOracle:
             np.asarray(src_idx, np.int32), np.asarray(dst_idx, np.int32)
         )
         buf = route_collective(
-            t.adj,
+            adj_eff,
             jnp.asarray(li),
             jnp.asarray(lj),
             jnp.asarray(util),
@@ -1199,8 +1340,8 @@ class RouteOracle:
             rounds=rounds,
             max_len=max_len,
             max_degree=t.max_degree,
-            dist=self._dist_d,  # cached at this topology version: no BFS
-            dst_nodes=jnp.asarray(dn) if len(dn) < t.v else None,
+            dist=dist_eff,  # cached at this topology version: no BFS
+            dst_nodes=jnp.asarray(dn) if len(dn) < v_eff else None,
         )
         _start_host_copy(buf)
 
@@ -1298,7 +1439,7 @@ class RouteOracle:
         )
         mesh = self._dag_mesh()
         if mesh is not None:
-            from sdnmpi_tpu.parallel.mesh import route_adaptive_sharded
+            from sdnmpi_tpu.shardplane import route_adaptive_sharded
 
             src_p, dst_p, w_p = self._pad_flows(
                 np.asarray(src_idx, np.int32), np.asarray(dst_idx, np.int32),
@@ -1306,11 +1447,13 @@ class RouteOracle:
             )
             # packed readback, same as the single-device branch below:
             # per-host readback bytes shrink ~10x at pod scale
-            inter, s1, s2, _ = route_adaptive_sharded(
-                t.adj, jnp.asarray(base.astype(np.float32)),
-                jnp.asarray(src_p), jnp.asarray(dst_p), jnp.asarray(w_p),
-                t.n_real, mesh, packed=True, **kwargs,
-            )
+            with self._shard_dispatch_scope(len(src_p)):
+                inter, s1, s2, _ = route_adaptive_sharded(
+                    t.adj, jnp.asarray(base.astype(np.float32)),
+                    jnp.asarray(src_p), jnp.asarray(dst_p),
+                    jnp.asarray(w_p), t.n_real, mesh, packed=True,
+                    **kwargs,
+                )
             inter = np.asarray(inter)
             n1, n2 = decode_segments(
                 t.host_adj(), src_p, dst_p, inter,
@@ -1353,10 +1496,61 @@ class RouteOracle:
         if not self.mesh_devices:
             return None
         if self._mesh is None:
-            from sdnmpi_tpu.parallel.mesh import make_mesh
+            from sdnmpi_tpu.shardplane import make_mesh
 
             self._mesh = make_mesh(self.mesh_devices)
+            _m_shard_mesh.set(self.mesh_devices)
         return self._mesh
+
+    def _shard_mesh(self):
+        """The mesh when the FULL shardplane backend is selected
+        (Config.shard_oracle), else None — the dispatch guard of the
+        sharded shortest-path leg."""
+        return self._dag_mesh() if self.shard_oracle else None
+
+    @contextlib.contextmanager
+    def _shard_dispatch_scope(self, n_flows: int):
+        """Per-dispatch shard span + shard_dispatch_seconds sample
+        around a sharded program enqueue. The span nests under the
+        Router's ambient ``route_window`` -> ``dispatch`` span
+        (tracing.start_child_span), so flight-recorder bundles
+        attribute a p99 spike to the sharded leg like any single-chip
+        stage. Context-managed so a raising dispatch (device error,
+        divisibility ValueError) cannot leak an open span and pin the
+        ambient CURRENT_SPAN to it — the defect class the reval spans
+        hit in PR 7."""
+        import time
+
+        from sdnmpi_tpu.utils.tracing import start_child_span
+
+        sp = start_child_span(
+            "shard_dispatch", mesh_devices=self.mesh_devices,
+            n_flows=n_flows,
+        )
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            _m_shard_dispatch_s.observe(time.perf_counter() - t0)
+            sp.end()
+
+    @staticmethod
+    def _shard_timed_reap(reap_fn):
+        """Wrap a sharded window's reap with the shard_reap_seconds
+        histogram (the blocking-transfer half of the dispatch/reap
+        split the pipelined install plane overlaps)."""
+        import functools
+        import time
+
+        @functools.wraps(reap_fn)
+        def timed():
+            t0 = time.perf_counter()
+            try:
+                return reap_fn()
+            finally:
+                _m_shard_reap_s.observe(time.perf_counter() - t0)
+
+        return timed
 
     @_timed_batch("routes_batch_balanced")
     def routes_batch_balanced(

@@ -1,484 +1,18 @@
-"""Multi-chip sharding of the path oracle.
-
-The reference's scale axis is topology size x flow count, handled by one
-Python thread (SURVEY §5 "long-context" analogue). Here the oracle shards
-across a ``jax.sharding.Mesh`` with two axes:
-
-- ``"v"`` (model-parallel-like): the ``[V, V]`` BFS/APSP state is
-  row-sharded — each device expands the frontier for its own block of
-  source switches with a local ``[V/s, V] @ [V, V]`` matmul. No
-  cross-device traffic inside the loop; XLA all-gathers the distance
-  blocks once afterward.
-- ``"flow"`` (data-parallel-like): a collective's flow batch is sharded;
-  each device greedily load-balances its shard, then the per-shard link
-  loads are ``psum``-ed into the global load/congestion figures.
-
-``multichip_route_step`` composes both under one ``jit`` — this is the
-"full training step" the driver dry-runs over N virtual devices, and the
-same code lays out work on a real multi-chip TPU slice where the psum
-rides the ICI.
+"""Compat shim: the multi-chip oracle prototype grew into a first-class
+backend at :mod:`sdnmpi_tpu.shardplane` (ISSUE 9). Every public name of
+the prototype re-exports from there; new code should import
+``sdnmpi_tpu.shardplane`` directly.
 """
 
-from __future__ import annotations
-
-import functools
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-
-try:  # jax >= 0.5 exports shard_map at top level
-    from jax import shard_map
-except ImportError:  # 0.4.x: experimental home, check_vma spelled check_rep
-    from jax.experimental.shard_map import shard_map as _shard_map_04
-
-    def shard_map(*args, **kwargs):
-        if "check_vma" in kwargs:
-            kwargs["check_rep"] = kwargs.pop("check_vma")
-        return _shard_map_04(*args, **kwargs)
-from jax.sharding import Mesh, PartitionSpec as P
-
-from sdnmpi_tpu.oracle.apsp import INF
-from sdnmpi_tpu.oracle.congestion import route_flows_balanced
-
-
-def make_mesh(n_devices: int) -> Mesh:
-    """Mesh over the first n devices: axes ("flow", "v"). With 4+ devices
-    both axes are non-trivial (n/2 x 2); fewer devices degenerate to
-    (n, 1)."""
-    devices = jax.devices()[:n_devices]
-    if len(devices) < n_devices:
-        raise ValueError(f"need {n_devices} devices, have {len(devices)}")
-    if n_devices >= 4 and n_devices % 2 == 0:
-        shape = (n_devices // 2, 2)
-    else:
-        shape = (n_devices, 1)
-    return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
-
-
-@functools.lru_cache(maxsize=None)
-def _apsp_sharded_fn(mesh: Mesh, v: int):
-    """Cached jitted shard_map BFS for (mesh, V) — jax.jit caches per
-    function OBJECT, so building the closure per call would retrace and
-    recompile the whole multi-device program on every topology version
-    bump (the exact path churn recovery rides)."""
-
-    @jax.jit
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(None, None), P("v", None)),
-        out_specs=P("v", None),
-        check_vma=False,  # per-shard while_loop trip counts legitimately vary
-    )
-    def block_bfs(a, reached0):
-
-        a = (a > 0).astype(jnp.float32)
-        dist0 = jnp.where(reached0 > 0, 0.0, INF)
-
-        def cond(carry):
-            _, _, t, changed = carry
-            return changed & (t <= v)
-
-        def body(carry):
-            reached, dist, t, _ = carry
-            grown = jnp.minimum(reached @ a + reached, 1.0)
-            newly = (grown > 0) & jnp.isinf(dist)
-            dist = jnp.where(newly, t.astype(jnp.float32), dist)
-            return grown, dist, t + 1, jnp.any(newly)
-
-        _, dist, _, _ = lax.while_loop(
-            cond, body, (reached0, dist0, jnp.int32(1), jnp.bool_(True))
-        )
-        return dist
-
-    return block_bfs
-
-
-def apsp_distances_sharded(adj: jax.Array, mesh: Mesh) -> jax.Array:
-    """Row-sharded BFS APSP: sources split across the "v" axis.
-
-    Functionally identical to oracle.apsp.apsp_distances; each shard runs
-    its own convergence loop (no collectives inside), so iteration count
-    is its local eccentricity bound.
-    """
-    v = adj.shape[0]
-    n_shards = mesh.shape["v"]
-    if v % n_shards:
-        raise ValueError(f"V={v} must divide by v-axis size {n_shards}")
-    return _apsp_sharded_fn(mesh, v)(adj, jnp.eye(v, dtype=jnp.float32))
-
-
-def route_flows_sharded(
-    adj: jax.Array,
-    dist: jax.Array,
-    base_cost: jax.Array,
-    src: jax.Array,
-    dst: jax.Array,
-    weight: jax.Array,
-    mesh: Mesh,
-    max_len: int,
-    chunk: int = 1024,
-    max_degree: int = 32,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Flow batch sharded over the "flow" axis; every device balances its
-    shard locally (greedy scan, oracle/congestion.py) and the link loads
-    are psum-ed into the global congestion picture."""
-    u = src.shape[0]
-    n_shards = mesh.shape["flow"] * mesh.shape["v"]
-    if u % n_shards:
-        raise ValueError(f"flow count {u} must divide by {n_shards} shards")
-
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(None, None),
-            P(None, None),
-            P(None, None),
-            P(("flow", "v")),
-            P(("flow", "v")),
-            P(("flow", "v")),
-        ),
-        out_specs=(P(("flow", "v")), P(None, None), P(None, None)),
-        check_vma=False,  # psum output is replicated by construction
-    )
-    def inner(a, d, base, s, t, w):
-        nodes, load, _ = route_flows_balanced(
-            a, d, base, s, t, w, max_len, chunk=chunk, max_degree=max_degree
-        )
-        load = lax.psum(load, ("flow", "v"))
-        maxc = jnp.max(jnp.where(a > 0, load, 0.0))
-        return nodes, load, maxc[None, None]
-
-    nodes, load, maxc = inner(adj, dist, base_cost, src, dst, weight)
-    return nodes, load, maxc[0, 0]
-
-
-def route_adaptive_sharded(
-    adj: jax.Array,
-    util: jax.Array,  # [V, V] f32 measured utilization (replicated)
-    src: jax.Array,
-    dst: jax.Array,
-    weight: jax.Array,
-    n_valid,
-    mesh: Mesh,
-    levels: int,
-    max_len: int = 8,
-    rounds: int = 2,
-    n_candidates: int = 4,
-    bias: float = 1.0,
-    max_degree: int = 32,
-    dist: jax.Array | None = None,  # cached apsp_distances(adj), else computed
-    packed: bool = False,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """UGAL adaptive routing with the flow batch sharded over ALL mesh
-    devices (the "flow" x "v" axes flattened — the [V, V] state is small
-    and replicated; flows are the scale axis).
-
-    The pipeline is staged so the balancing is *globally* consistent
-    with the single-device ``route_adaptive``: each shard makes UGAL
-    decisions and builds traffic for its own flows, the per-shard
-    traffic matrices are ``psum``-ed (one [V, V] all-reduce over ICI),
-    and every shard then runs the SAME balance_rounds on the full
-    batch's traffic — so split weights, the load matrix, and the
-    congestion figure all reflect the whole collective, exactly as if
-    routed on one device. Per-flow hash streams are seeded with each
-    flow's *global* batch index (shard base + local offset), so UGAL
-    choices and sampled paths match the single-device ``route_adaptive``
-    on the same batch — bit-identical when the weights sum exactly in
-    f32 (e.g. integer weights; fractional weights can differ by an ulp
-    between the psum and the single-device scatter-add, which may flip
-    a tied Gumbel argmax downstream).
-
-    Same return contract as ``route_adaptive``: (inter, nodes1, nodes2,
-    load), with nodes/inter sharded over flows and load replicated.
-    ``packed=True`` skips the in-program decode and returns the int8
-    slot streams instead of node rows — the same ~10x readback-bytes
-    contraction the single-device path uses (oracle/adaptive.py), which
-    matters per host at pod scale; decode with
-    ``oracle.adaptive.decode_segments``.
-    """
-    from sdnmpi_tpu.oracle.adaptive import (
-        congestion_cost,
-        dag_weighted_costs,
-        ugal_choose,
-    )
-    from sdnmpi_tpu.oracle.apsp import apsp_distances
-    from sdnmpi_tpu.oracle.dag import (
-        balance_rounds,
-        decode_slots_jax,
-        sample_paths_dense,
-        sampled_hops,
-    )
-
-    u = src.shape[0]
-    n_shards = mesh.shape["flow"] * mesh.shape["v"]
-    if u % n_shards:
-        raise ValueError(f"flow count {u} must divide by {n_shards} shards")
-    have_dist = dist is not None
-    dist_arg = dist if have_dist else jnp.zeros_like(adj)
-
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(None, None),
-            P(None, None),
-            P(None, None),
-            P(("flow", "v")),
-            P(("flow", "v")),
-            P(("flow", "v")),
-            P(),
-        ),
-        out_specs=(
-            P(("flow", "v")),
-            P(("flow", "v")),
-            P(("flow", "v")),
-            P(None, None),
-        ),
-        check_vma=False,  # psum-derived outputs are replicated
-    )
-    def inner(a, d_in, cost_util, s, t, w, nv):
-        v = a.shape[0]
-        # global index of this shard's first flow: hash streams must be
-        # keyed by global flow id for parity with route_adaptive
-        shard_idx = lax.axis_index("flow") * mesh.shape["v"] + lax.axis_index("v")
-        fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
-        d = d_in if have_dist else apsp_distances(a)
-        cost = congestion_cost(a, cost_util)
-        dmin = dag_weighted_costs(a, d, cost, levels=levels, max_degree=max_degree)
-        inter = ugal_choose(
-            dmin, s, t, nv, n_candidates=n_candidates, bias=bias,
-            fid_base=fid_base,
-        )
-
-        detour = inter >= 0
-        mid = jnp.where(detour, inter, t)
-        s2 = jnp.where(detour, mid, -1)
-        d2 = jnp.where(detour, t, -1)
-        w_live = jnp.where((s >= 0) & (t >= 0), w, 0.0)
-        traffic = jnp.zeros((v, v), jnp.float32)
-        traffic = traffic.at[jnp.maximum(mid, 0), jnp.maximum(s, 0)].add(
-            jnp.where(s >= 0, w_live, 0.0)
-        )
-        traffic = traffic.at[jnp.maximum(d2, 0), jnp.maximum(s2, 0)].add(
-            jnp.where(detour, w_live, 0.0)
-        )
-        # the one collective: every shard balances the FULL batch
-        traffic = lax.psum(traffic, ("flow", "v"))
-
-        weights, load, _ = balance_rounds(
-            a, d, cost_util, traffic, levels=levels, rounds=rounds
-        )
-        # forced-hop elision + device decode, same contraction as the
-        # single-device route_adaptive (bit-identical nodes; the decode
-        # is pure XLA, so it shard_maps like the rest of the pipeline)
-        hops = sampled_hops(max_len)
-        _, sl1 = sample_paths_dense(weights, d, s, mid, hops, fid_base=fid_base)
-        _, sl2 = sample_paths_dense(
-            weights, d, s2, d2, hops, salt=0x5BD1E995, fid_base=fid_base
-        )
-        if packed:
-            return inter, sl1, sl2, load
-        n1 = decode_slots_jax(a, sl1, s, mid)[:, :max_len]
-        n2 = decode_slots_jax(a, sl2, s2, d2)[:, :max_len]
-        return inter, n1, n2, load
-
-    return inner(adj, dist_arg, util, src, dst, weight, jnp.int32(n_valid))
-
-
-def route_collective_sharded(
-    adj: jax.Array,  # [V, V] 0/1 (replicated)
-    link_src: jax.Array,  # [E] int32 row index of each real link
-    link_dst: jax.Array,  # [E] int32 col index
-    link_util: jax.Array,  # [E] f32 measured utilization per link
-    traffic: jax.Array,  # [V, V] f32 traffic[t, i] — T axis sharded
-    src: jax.Array,  # [F] int32 flow sources (-1 pad) — sharded
-    dst: jax.Array,  # [F] int32 flow destinations — sharded
-    mesh: Mesh,
-    levels: int,
-    rounds: int,
-    max_len: int,
-    salt: int = 0,
-    dist: jax.Array | None = None,  # cached APSP distances, else computed
-    dst_nodes: jax.Array | None = None,  # [T] int32 destination set (-1 pad)
-) -> tuple[jax.Array, jax.Array]:
-    """The flagship MXU DAG engine (oracle/dag.route_collective) sharded
-    over every device of the mesh ("flow" x "v" axes flattened).
-
-    Sharding follows the engine's own structure:
-
-    - ``propagate_levels`` is [T, V] x [V, V] matmuls masked by the
-      destination-distance levels — embarrassingly parallel over the T
-      (destination) axis. Each device propagates the traffic destined to
-      its own block of switches and the per-link loads are ``psum``-ed
-      (one [V, V] all-reduce over ICI per balance round), so the
-      congestion reweighting sees the SAME global load matrix as the
-      single-device path.
-    - ``sample_paths_dense`` is embarrassingly parallel over flows; each
-      shard samples its slice with ``fid_base`` set to the slice's global
-      offset, so every flow draws the same Gumbel noise stream as on one
-      device.
-    - If no cached ``dist`` is passed, APSP runs row-sharded
-      (``apsp_distances_sharded``) and XLA all-gathers the blocks into
-      the replicated distance matrix the DAG stages need.
-
-    Exact hop-count distances and the dyadic splits of idle fat-trees
-    make the sharded slots bit-identical to ``route_collective``'s (see
-    tests/test_mesh_dag.py); the congestion figure may differ by ulps
-    because the psum and the single-device matmul reduce in different
-    orders.
-
-    ``dst_nodes`` applies the destination-set restriction of
-    ``route_collective(dst_nodes=...)`` to the sharded path: each device
-    propagates a T/n_shards block of the restricted [T, V] traffic
-    instead of a V/n_shards block of the full matrix (bit-identical —
-    the dropped rows carry zero traffic), and the samplers extract
-    destination distances from the compact [T, V] rows. T must divide by
-    the shard count.
-
-    Returns ``(slots [F, sampled_hops(max_len)] int8, max_congestion
-    f32 scalar)`` — the unpacked form of ``route_collective``'s buffer;
-    decode with ``slots_to_nodes(..., complete=True)``. Requires V and F
-    divisible by the total shard count. Reference seam: this serves the
-    whole-collective request of sdnmpi/topology.py:138-142 at the scale
-    axis of SURVEY §5.
-    """
-    v = adj.shape[0]
-    f = src.shape[0]
-    n_shards = mesh.shape["flow"] * mesh.shape["v"]
-    if v % n_shards:
-        raise ValueError(f"V={v} must divide by {n_shards} shards")
-    if f % n_shards:
-        raise ValueError(f"flow count {f} must divide by {n_shards} shards")
-    have_dist = dist is not None
-    dist_arg = dist if have_dist else jnp.zeros_like(adj, dtype=jnp.float32)
-    have_dst = dst_nodes is not None
-    if have_dst and dst_nodes.shape[0] % n_shards:
-        raise ValueError(
-            f"dst set T={dst_nodes.shape[0]} must divide by {n_shards} shards"
-        )
-    dst_arg = (
-        dst_nodes if have_dst else jnp.zeros((n_shards,), dtype=jnp.int32)
-    )
-    step = _dag_step(mesh, levels, rounds, max_len, salt, have_dist, have_dst)
-    return step(
-        adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg,
-        dst_arg,
-    )
-
-
-@functools.lru_cache(maxsize=None)
-def _dag_step(
-    mesh: Mesh, levels: int, rounds: int, max_len: int, salt: int,
-    have_dist: bool, have_dst: bool = False,
-):
-    """Build (and cache) the jitted sharded DAG step for one config.
-
-    jax.jit caches per function object, so the closure must be reused
-    across calls — a steady-state caller routing one collective per
-    second would otherwise retrace and recompile the whole multi-device
-    program every time. Keyed on the mesh (hashable) and the static
-    routing parameters; array shapes are handled by jit's own cache.
-    """
-    from sdnmpi_tpu.oracle.dag import (
-        congestion_weights,
-        propagate_levels,
-        sample_paths_dense,
-        sampled_hops,
-    )
-
-    hops = sampled_hops(max_len)
-
-    @jax.jit
-    def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in,
-             dst_nodes):
-        v = adj.shape[0]
-        base = (
-            jnp.zeros((v, v), jnp.float32)
-            .at[link_src, link_dst]
-            .set(link_util, unique_indices=True, mode="drop")
-        )
-        d = dist_in if have_dist else apsp_distances_sharded(adj, mesh)
-        if have_dst:
-            # restrict the destination axis BEFORE sharding: each device
-            # then owns a T/n_shards block of the compact rows
-            from sdnmpi_tpu.oracle.dag import restrict_dst
-
-            d_t, traffic = restrict_dst(d, traffic, dst_nodes)
-        else:
-            d_t = d.T
-
-        @functools.partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(
-                P(None, None),  # adj
-                P(None, None),  # dist (replicated: sampler walks all of it)
-                P(("flow", "v"), None),  # dist.T rows for this T block
-                P(None, None),  # base cost
-                P(("flow", "v"), None),  # traffic T block
-                P(("flow", "v")),  # src slice
-                P(("flow", "v")),  # dst slice
-                P(None),  # dst set (replicated: samplers match on it)
-            ),
-            out_specs=(P(("flow", "v"), None), P(None, None)),
-            check_vma=False,  # psum-derived outputs are replicated
-        )
-        def inner(a, d_full, d_t_local, base, traffic_local, s, t, dn):
-            adj_f = (a > 0).astype(jnp.float32)
-            weights = congestion_weights(adj_f, base)
-            load = lax.psum(
-                propagate_levels(weights, d_t_local, traffic_local, levels),
-                ("flow", "v"),
-            )
-            for _ in range(rounds - 1):
-                weights = congestion_weights(adj_f, base + load)
-                load = lax.psum(
-                    propagate_levels(weights, d_t_local, traffic_local, levels),
-                    ("flow", "v"),
-                )
-            maxc = jnp.max(load)
-
-            shard_idx = (
-                lax.axis_index("flow") * mesh.shape["v"] + lax.axis_index("v")
-            )
-            fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
-            _, slots = sample_paths_dense(
-                weights, d_full, s, t, hops, salt=salt, fid_base=fid_base,
-                dst_nodes=dn if have_dst else None,
-            )
-            return slots, maxc[None, None]
-
-        slots, maxc = inner(adj, d, d_t, base, traffic, src, dst, dst_nodes)
-        return slots, maxc[0, 0]
-
-    return step
-
-
-def multichip_route_step(
-    adj: jax.Array,
-    base_cost: jax.Array,
-    src: jax.Array,
-    dst: jax.Array,
-    weight: jax.Array,
-    mesh: Mesh,
-    max_len: int,
-    chunk: int = 1024,
-    max_degree: int = 32,
-):
-    """The full sharded oracle step under one jit: row-sharded APSP, an
-    implicit all-gather of the distance blocks, then flow-sharded
-    balanced routing with psum-ed congestion."""
-
-    @jax.jit
-    def step(adj, base_cost, src, dst, weight):
-        dist = apsp_distances_sharded(adj, mesh)
-        return route_flows_sharded(
-            adj, dist, base_cost, src, dst, weight, mesh, max_len, chunk,
-            max_degree,
-        )
-
-    return step(adj, base_cost, src, dst, weight)
+from sdnmpi_tpu.shardplane.apsp import (  # noqa: F401
+    _apsp_sharded_fn,
+    apsp_distances_sharded,
+)
+from sdnmpi_tpu.shardplane.mesh import make_mesh, shard_map  # noqa: F401
+from sdnmpi_tpu.shardplane.routes import (  # noqa: F401
+    _dag_step,
+    multichip_route_step,
+    route_adaptive_sharded,
+    route_collective_sharded,
+    route_flows_sharded,
+)

@@ -1,0 +1,36 @@
+"""Pod-scale sharded path oracle (ISSUE 9).
+
+The single-chip oracle saturates around V=2048; the shardplane scales
+the distance/next-hop tensors and every batched routing kernel across a
+``jax.sharding.Mesh`` — real chips on a slice, or the 8-way virtual CPU
+mesh tier-1 exercises (tests/conftest.py). Selected behind the existing
+seams by ``Config.shard_oracle`` + ``--mesh-devices N``; the Router,
+coalescer, UtilPlane feed, delta-repair log, and recovery plane are
+untouched consumers.
+
+- :mod:`~sdnmpi_tpu.shardplane.mesh` — mesh construction + axis facts
+- :mod:`~sdnmpi_tpu.shardplane.apsp` — row-block-sharded APSP
+  (distances AND next hops), occupancy-bucketed columns
+- :mod:`~sdnmpi_tpu.shardplane.routes` — flow-sharded batch scoring
+  with packed per-host readback (promoted from parallel/mesh.py)
+"""
+
+from sdnmpi_tpu.shardplane.apsp import (  # noqa: F401
+    apsp_distances_rowsharded,
+    apsp_distances_sharded,
+    apsp_next_hops_rowsharded,
+)
+from sdnmpi_tpu.shardplane.mesh import (  # noqa: F401
+    host_shard_devices,
+    make_mesh,
+    mesh_axes,
+    mesh_shards,
+)
+from sdnmpi_tpu.shardplane.routes import (  # noqa: F401
+    batch_fdb_sharded,
+    multichip_route_step,
+    route_adaptive_sharded,
+    route_collective_sharded,
+    route_flows_sharded,
+    window_readback_nbytes,
+)

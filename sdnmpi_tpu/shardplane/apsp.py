@@ -1,0 +1,216 @@
+"""Block-sharded APSP: distances AND next hops across the device mesh.
+
+The single-chip oracle computes the ``[V, V]`` distance matrix as BFS
+frontier matmuls and the next-hop matrix as a degree-compact argmin
+(oracle/apsp.py); both saturate one chip around V=2048. Here the row
+axis (BFS sources / next-hop rows) splits across every device of the
+shardplane mesh:
+
+- ``apsp_distances_rowsharded``: each device expands the frontier for
+  its own block of source rows with a local ``[V/s, V] @ [V, V]``
+  matmul — rows are independent, so any row partition is bit-identical
+  to the single-chip kernel, and each shard's ``while_loop`` exits at
+  its local eccentricity bound (a shard owning only padding rows
+  converges after one step, the implicit occupancy win).
+- ``apsp_next_hops_rowsharded``: the degree-compact candidate gather +
+  argmin for each device's row block, destination columns processed in
+  VMEM-bounded blocks exactly like the single-chip kernel. Occupancy
+  bucketing (``n_occ``) restricts the computed columns to the occupied
+  block; columns at or past ``n_occ`` are analytic (diagonal = row,
+  everything else unreachable) because padding nodes have no links.
+
+Both shard the same ops elementwise as their single-chip twins — the
+bit-identity fence in tests/test_shardplane.py pins it per generator
+topology. The legacy "v"-axis-only BFS (``apsp_distances_sharded``)
+stays for the mesh_devices-era refresh path, unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# _bfs_rows IS apsp_distances' loop body (one shared implementation, so
+# the sharded distances can never drift from the single-chip ones)
+from sdnmpi_tpu.oracle.apsp import (
+    INF,
+    _bfs_rows,
+    _degree_compact_block,
+    _fit_block,
+)
+from sdnmpi_tpu.shardplane.mesh import P, mesh_axes, mesh_shards, shard_map
+
+
+@functools.lru_cache(maxsize=None)
+def _apsp_sharded_fn(mesh, v: int):
+    """Cached jitted shard_map BFS for (mesh, V) — jax.jit caches per
+    function OBJECT, so building the closure per call would retrace and
+    recompile the whole multi-device program on every topology version
+    bump (the exact path churn recovery rides)."""
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P("v", None)),
+        out_specs=P("v", None),
+        check_vma=False,  # per-shard while_loop trip counts legitimately vary
+    )
+    def block_bfs(a, reached0):
+        a = (a > 0).astype(jnp.float32)
+        dist0 = jnp.where(reached0 > 0, 0.0, INF)
+        return _bfs_rows(a, reached0, dist0, v)
+
+    return block_bfs
+
+
+def apsp_distances_sharded(adj: jax.Array, mesh) -> jax.Array:
+    """Row-sharded BFS APSP over the mesh's "v" axis only (the
+    mesh_devices-era refresh kernel, kept for the default backend).
+
+    Functionally identical to oracle.apsp.apsp_distances; each shard runs
+    its own convergence loop (no collectives inside), so iteration count
+    is its local eccentricity bound.
+    """
+    v = adj.shape[0]
+    n_shards = mesh.shape["v"]
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by v-axis size {n_shards}")
+    return _apsp_sharded_fn(mesh, v)(adj, jnp.eye(v, dtype=jnp.float32))
+
+
+@functools.lru_cache(maxsize=None)
+def _apsp_rowsharded_fn(mesh, v: int):
+    """BFS with source rows split across EVERY mesh device (the
+    shardplane refresh kernel). Cached per (mesh, V) like the legacy
+    builder, for the same churn-must-not-recompile reason."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    axes = mesh_axes(mesh)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P(axes, None)),
+        out_specs=P(axes, None),
+        check_vma=False,  # per-shard while_loop trip counts legitimately vary
+    )
+    def block_bfs(a, reached0):
+        count_trace("shard_apsp")
+        a = (a > 0).astype(jnp.float32)
+        dist0 = jnp.where(reached0 > 0, 0.0, INF)
+        return _bfs_rows(a, reached0, dist0, v)
+
+    return block_bfs
+
+
+def apsp_distances_rowsharded(adj: jax.Array, mesh) -> jax.Array:
+    """Hop-count distance matrix with BFS sources sharded over all mesh
+    devices — bit-identical to ``oracle.apsp.apsp_distances`` (rows are
+    independent). Requires ``V % mesh_shards(mesh) == 0``."""
+    v = adj.shape[0]
+    n_shards = mesh_shards(mesh)
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by {n_shards} mesh devices")
+    return _apsp_rowsharded_fn(mesh, v)(adj, jnp.eye(v, dtype=jnp.float32))
+
+
+def _flat_shard_index(mesh) -> jax.Array:
+    """Flattened device index inside a shard_map body: row-major over
+    the mesh's axes, matching how shard_map lays row blocks out."""
+    idx = jnp.int32(0)
+    for name in mesh.axis_names:
+        idx = idx * mesh.shape[name] + lax.axis_index(name)
+    return idx
+
+
+@functools.lru_cache(maxsize=None)
+def _nexthop_rowsharded_fn(mesh, v: int, max_degree: int, n_cols: int):
+    """Cached jitted row-sharded next-hop kernel for one (mesh, V,
+    degree bound, occupied-column bucket) tuple. ``n_cols`` is the
+    bucketed occupied column count (== V when occupancy is off); the
+    caller buckets it, so the jit ladder stays bounded."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    axes = mesh_axes(mesh)
+    n_shards = mesh_shards(mesh)
+    rows_per = v // n_shards
+    d = min(max_degree, v)
+    block = _fit_block(n_cols, rows_per * d)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, None),  # full dist: candidate rows live anywhere
+            P(axes, None),  # my rows' dist block (mask + diagonal)
+            P(axes, None),  # my rows' neighbor-valid mask
+            P(axes, None),  # my rows' sorted-neighbor table
+        ),
+        out_specs=P(axes, None),
+        check_vma=False,  # outputs are genuinely row-sharded
+    )
+    def block_nexthops(dist_full, dist_mine, valid_b, safe_b):
+        count_trace("shard_next_hops")
+        row0 = _flat_shard_index(mesh) * rows_per
+        rows = row0 + jnp.arange(rows_per, dtype=jnp.int32)
+        cols = jnp.arange(v, dtype=jnp.int32)
+
+        def per_block(cols_b):  # [B] occupied destination columns
+            db = dist_full[:, cols_b]  # [V, B]
+            return _degree_compact_block(valid_b, safe_b, db)
+
+        occ_cols = jnp.arange(n_cols, dtype=jnp.int32)
+        if block == n_cols:
+            core = per_block(occ_cols)
+        else:
+            blocks = lax.map(
+                per_block, occ_cols.reshape(n_cols // block, block)
+            )
+            core = jnp.moveaxis(blocks, 0, 1).reshape(rows_per, n_cols)
+        # columns past the occupied bucket are analytic: padding nodes
+        # have no links, so only the diagonal self-hop exists there
+        nxt = jnp.full((rows_per, v), 0, jnp.int32)
+        nxt = lax.dynamic_update_slice(nxt, core, (0, 0))
+        nxt = jnp.where(jnp.isinf(dist_mine), -1, nxt)
+        return jnp.where(rows[:, None] == cols[None, :], rows[:, None], nxt)
+
+    return block_nexthops
+
+
+def apsp_next_hops_rowsharded(
+    adj: jax.Array,
+    dist: jax.Array,
+    mesh,
+    max_degree: int,
+    n_occ: int = 0,
+) -> jax.Array:
+    """Next-hop matrix with rows sharded over all mesh devices.
+
+    Same contract as ``oracle.apsp.apsp_next_hops(max_degree=...)``:
+    lowest-index tie-break through the sorted-neighbor table (reference
+    parity), ``-1`` for unreachable, ``i`` on the diagonal — and the
+    same elementwise op sequence per row, so the sharded matrix is
+    bit-identical. The neighbor table builds once outside the shard_map
+    (replicated — it is [V, D], small) and each device receives only
+    its own row block of it.
+
+    ``n_occ`` > 0 restricts the computed destination columns to the
+    occupied bucket (columns past it are analytic — see module doc);
+    pass the bucketed occupancy from the engine, 0 for the full width.
+    """
+    from sdnmpi_tpu.oracle.dag import neighbor_table
+
+    v = adj.shape[0]
+    n_shards = mesh_shards(mesh)
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by {n_shards} mesh devices")
+    n_cols = v if n_occ <= 0 else min(v, n_occ)
+    _, valid, safe = neighbor_table(adj, max_degree)
+    fn = _nexthop_rowsharded_fn(mesh, v, max_degree, n_cols)
+    return fn(dist, dist, valid, safe)

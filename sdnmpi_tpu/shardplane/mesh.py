@@ -1,0 +1,78 @@
+"""Device-mesh construction for the pod-scale sharded oracle.
+
+The shardplane is the multi-chip form of the path oracle (ISSUE 9): the
+``[V, V]`` distance/next-hop tensors row-shard across the mesh's
+combined device axis and flow batches partition across the same
+devices. This module owns the mesh itself:
+
+- ``make_mesh(n)`` builds the ``("flow", "v")`` mesh the routing
+  kernels were proven on (promoted verbatim from the parallel/mesh.py
+  prototype — SNIPPETS.md [1]/[3] pjit partitioning, [2] shard_map ring
+  DMA are the exemplar patterns).
+- ``mesh_shards``/``mesh_axes`` are the two facts every shardplane
+  kernel needs: the total device count a tensor axis must divide by,
+  and the axis-name tuple to shard it over. Kernels written against
+  these work on any mesh shape — the 8-way virtual CPU mesh tier-1
+  runs on, and a real multi-chip slice where the psums ride the ICI.
+- ``host_shard_devices(n)`` answers "can this host mesh n ways" once,
+  for the launch path and the bench smoke step (tpu_validate.sh): real
+  devices when present, else whatever the virtual-device flags exposed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x: experimental home, check_vma spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(*args, **kwargs)
+
+
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402,F401
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    """Mesh over the first n devices: axes ("flow", "v"). With 4+ devices
+    both axes are non-trivial (n/2 x 2); fewer devices degenerate to
+    (n, 1)."""
+    devices = jax.devices()[:n_devices]
+    if len(devices) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+    if n_devices >= 4 and n_devices % 2 == 0:
+        shape = (n_devices // 2, 2)
+    else:
+        shape = (n_devices, 1)
+    return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
+
+
+def mesh_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The axis-name tuple a shardplane tensor shards over — ALL of the
+    mesh's axes flattened, so an [F] flow batch or the [V, V] row axis
+    splits across every device regardless of the mesh's logical shape."""
+    return tuple(mesh.axis_names)
+
+
+def mesh_shards(mesh: Mesh) -> int:
+    """Total device count of the mesh — the divisor every sharded axis
+    (V rows, flow batches, destination sets) must satisfy."""
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def host_shard_devices(requested: int = 0) -> int:
+    """How many devices a shardplane mesh can span on this host.
+
+    ``requested`` > 0 clamps to what exists; 0 asks for everything. The
+    answer counts whatever ``jax.devices()`` exposes — real chips on a
+    slice, or the virtual CPU devices ``--xla_force_host_platform_
+    device_count`` created (the tier-1 dev loop; see tests/conftest.py).
+    """
+    have = len(jax.devices())
+    return min(requested, have) if requested > 0 else have

@@ -201,6 +201,20 @@ def start_span(name: str, parent=None, **fields):
     return Span(name, pid, **fields)
 
 
+def start_child_span(name: str, **fields):
+    """Open a span parented to the AMBIENT active span (the
+    ``CURRENT_SPAN`` id exemplars attribute to) — for stages that run
+    below an explicitly-parented span but behind a seam that does not
+    thread the Span object. The oracle's sharded dispatch legs use
+    this: the Router's ``route_window``/``dispatch`` span is active
+    when the engine runs, so the shardplane leg nests under it in
+    flight-recorder bundles exactly like the single-chip stages, with
+    no oracle-API change. Parent id 0 (no ambient span) makes a root."""
+    if _sink is None and not _extra_sinks:
+        return NULL_SPAN
+    return Span(name, CURRENT_SPAN[0], **fields)
+
+
 @contextlib.contextmanager
 def span(name: str, parent=None, **fields):
     """Context-manager form of :func:`start_span`."""

@@ -28,6 +28,30 @@ if not os.environ.get("SDNMPI_TEST_TPU"):
 
 import pytest  # noqa: E402
 
+#: device count of the shared virtual mesh every sharding test runs on
+#: (the XLA flag above forces it on the CPU backend)
+N_VIRTUAL_DEVICES = 8
+
+
+@pytest.fixture(scope="session")
+def virtual_mesh():
+    """The 8-device virtual mesh, built ONCE per session — the shared
+    fixture the shardplane/mesh tests consume instead of each repeating
+    the device-count check + ``make_mesh`` dance (ISSUE 9 satellite).
+    Session scope also keeps every test on the SAME Mesh object, so the
+    lru-cached shard_map builders (shardplane.apsp/routes) are shared
+    across the whole run instead of recompiling per test. Skips when
+    the platform cannot host the virtual devices (e.g. a real-TPU run
+    with fewer chips: SDNMPI_TEST_TPU keeps the hardware backend)."""
+    if len(jax.devices()) < N_VIRTUAL_DEVICES:
+        pytest.skip(
+            f"platform exposes {len(jax.devices())} device(s); the "
+            f"virtual mesh needs {N_VIRTUAL_DEVICES}"
+        )
+    from sdnmpi_tpu.shardplane import make_mesh
+
+    return make_mesh(N_VIRTUAL_DEVICES)
+
 
 @pytest.fixture(autouse=True)
 def _flight_isolation():

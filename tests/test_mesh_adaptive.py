@@ -1,4 +1,5 @@
-"""Sharded UGAL routing over the virtual 8-device mesh (parallel/mesh.py).
+"""Sharded UGAL routing over the shared virtual 8-device mesh
+(shardplane/routes.py; mesh fixture in tests/conftest.py).
 
 The single-device route_adaptive is the semantics reference: the sharded
 version must produce valid stitched paths and a psum-ed global load
@@ -10,12 +11,12 @@ import numpy as np
 
 from sdnmpi_tpu.oracle.adaptive import link_loads, stitch_paths
 from sdnmpi_tpu.oracle.engine import tensorize
-from sdnmpi_tpu.parallel.mesh import make_mesh, route_adaptive_sharded
+from sdnmpi_tpu.shardplane import route_adaptive_sharded
 from sdnmpi_tpu.topogen import dragonfly
 
 
-def test_sharded_adaptive_valid_paths_and_global_load():
-    mesh = make_mesh(8)
+def test_sharded_adaptive_valid_paths_and_global_load(virtual_mesh):
+    mesh = virtual_mesh
     spec = dragonfly(4, 4)
     db = spec.to_topology_db(backend="jax")
     t = tensorize(db)
@@ -57,12 +58,12 @@ def test_sharded_adaptive_valid_paths_and_global_load():
     np.testing.assert_allclose(load.sum(), discrete.sum(), rtol=1e-4)
 
 
-def test_sharded_adaptive_matches_single_device():
+def test_sharded_adaptive_matches_single_device(virtual_mesh):
     """Hash streams are keyed by *global* flow index, so the sharded
     pipeline reproduces route_adaptive bit-for-bit on the same batch."""
     from sdnmpi_tpu.oracle.adaptive import route_adaptive
 
-    mesh = make_mesh(8)
+    mesh = virtual_mesh
     spec = dragonfly(4, 4)
     db = spec.to_topology_db(backend="jax")
     t = tensorize(db)

@@ -1,8 +1,8 @@
 """Sharded flagship DAG engine vs the single-device ``route_collective``.
 
 The MXU DAG balancer (oracle/dag.py) is the path bench.py measures; this
-module proves its multi-chip form (parallel/mesh.route_collective_sharded)
-on the virtual 8-device mesh: bit-identical sampled slots on an idle
+module proves its multi-chip form (shardplane.route_collective_sharded)
+on the shared virtual 8-device mesh (tests/conftest.virtual_mesh): bit-identical sampled slots on an idle
 fabric (dyadic splits + global-flow-id hash streams), and valid decoded
 paths + a consistent congestion figure under measured utilization.
 """
@@ -16,10 +16,9 @@ from sdnmpi_tpu.oracle.dag import (
     unpack_result,
 )
 from sdnmpi_tpu.oracle.engine import tensorize
-from sdnmpi_tpu.parallel.mesh import make_mesh, route_collective_sharded
+from sdnmpi_tpu.shardplane import route_collective_sharded
 from sdnmpi_tpu.topogen import fattree
-
-N_SHARDS = 8
+from tests.conftest import N_VIRTUAL_DEVICES as N_SHARDS
 MAX_LEN = 6  # fat-tree k=4 diameter is 4 edges -> 5 nodes
 
 
@@ -64,11 +63,11 @@ def _assert_valid_paths(adj_host, src, dst, slots):
     return nodes
 
 
-def test_sharded_dag_matches_single_device():
+def test_sharded_dag_matches_single_device(virtual_mesh):
     """Idle fabric: every split is dyadic and hash streams are keyed by
     global flow id, so the sharded engine reproduces route_collective's
     sampled slots bit-for-bit."""
-    mesh = make_mesh(N_SHARDS)
+    mesh = virtual_mesh
     t, adj_host, src, dst, traffic, li, lj = _problem()
     util = np.zeros(len(li), np.float32)
 
@@ -92,13 +91,13 @@ def test_sharded_dag_matches_single_device():
     _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
 
 
-def test_sharded_dag_dst_restricted_matches_full():
+def test_sharded_dag_dst_restricted_matches_full(virtual_mesh):
     """dst_nodes on the sharded path: each device owns a block of the
     compact [T, V] destination rows; slots stay bit-identical to the
     unrestricted single-device engine."""
     from sdnmpi_tpu.oracle.dag import make_dst_nodes
 
-    mesh = make_mesh(N_SHARDS)
+    mesh = virtual_mesh
     t, adj_host, src, dst, traffic, li, lj = _problem()
     util = np.zeros(len(li), np.float32)
 
@@ -121,11 +120,11 @@ def test_sharded_dag_dst_restricted_matches_full():
     _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
 
 
-def test_sharded_dag_under_utilization():
+def test_sharded_dag_under_utilization(virtual_mesh):
     """Measured link utilization steers the sharded balancer the same
     way as the single-device one: paths stay valid, the psum-ed
     congestion figure matches within float tolerance."""
-    mesh = make_mesh(N_SHARDS)
+    mesh = virtual_mesh
     t, adj_host, src, dst, traffic, li, lj = _problem()
     rng = np.random.default_rng(7)
     util = rng.uniform(0.0, 8.0, len(li)).astype(np.float32)
@@ -147,7 +146,7 @@ def test_sharded_dag_under_utilization():
     _assert_valid_paths(adj_host, src, dst, np.asarray(slots_s))
 
 
-def test_engine_mesh_devices_matches_single_device():
+def test_engine_mesh_devices_matches_single_device(virtual_mesh):
     """The production seam: TopologyDB(mesh_devices=8) routes balanced
     batches through the sharded DAG engine with fdbs identical to the
     single-device oracle (Config.mesh_devices is just a scale knob)."""
@@ -172,7 +171,7 @@ def test_engine_mesh_devices_matches_single_device():
     assert results[0][0] == results[N_SHARDS][0]
 
 
-def test_engine_mesh_devices_adaptive_matches_single_device():
+def test_engine_mesh_devices_adaptive_matches_single_device(virtual_mesh):
     """The UGAL engine path also dispatches to the mesh: identical fdbs
     and detour counts on the virtual 8-device mesh."""
     from sdnmpi_tpu.topogen import dragonfly
@@ -192,7 +191,7 @@ def test_engine_mesh_devices_adaptive_matches_single_device():
     assert det0 == det8
 
 
-def test_engine_mesh_collective_adaptive_matches_single_device():
+def test_engine_mesh_collective_adaptive_matches_single_device(virtual_mesh):
     """The array-native whole-collective path (the block-install seam)
     also dispatches its adaptive branch through the mesh, with
     identical routes."""
@@ -218,13 +217,13 @@ def test_engine_mesh_collective_adaptive_matches_single_device():
     assert r0.n_detours == r8.n_detours
 
 
-def test_sharded_dag_cached_dist():
+def test_sharded_dag_cached_dist(virtual_mesh):
     """Steady-state callers pass the cached APSP matrix; the sharded
     engine must honor it (no BFS) and still agree with the from-scratch
     run."""
     from sdnmpi_tpu.oracle.apsp import apsp_distances
 
-    mesh = make_mesh(N_SHARDS)
+    mesh = virtual_mesh
     t, adj_host, src, dst, traffic, li, lj = _problem()
     util = np.zeros(len(li), np.float32)
     dist = apsp_distances(t.adj)
@@ -243,7 +242,7 @@ def test_sharded_dag_cached_dist():
     np.testing.assert_allclose(float(maxc_a), float(maxc_b), rtol=1e-6)
 
 
-def test_refresh_sharded_apsp_matches_single_device():
+def test_refresh_sharded_apsp_matches_single_device(virtual_mesh):
     """With mesh_devices configured, the oracle refresh row-shards its
     APSP over the mesh; distances, next hops, and routes (including
     after a churn mutation) must equal the single-device refresh."""
@@ -279,40 +278,40 @@ def test_refresh_sharded_apsp_matches_single_device():
     assert routes[0] == routes[N_SHARDS] and routes[0]
 
 
-def test_sharded_apsp_builder_is_cached():
+def test_sharded_apsp_builder_is_cached(virtual_mesh):
     """The shard_map BFS must be built once per (mesh, V): a fresh
     closure per call would retrace + recompile the multi-device program
     on every topology version bump (churn would become compile-bound)."""
     import jax.numpy as jnp
     import numpy as np
 
-    from sdnmpi_tpu.parallel import mesh as pm
+    from sdnmpi_tpu.shardplane import apsp as pa
 
-    m = pm.make_mesh(N_SHARDS)
+    m = virtual_mesh
     rng = np.random.default_rng(0)
     adj1 = jnp.asarray((rng.random((16, 16)) < 0.3).astype(np.float32))
     adj2 = jnp.asarray((rng.random((16, 16)) < 0.3).astype(np.float32))
-    pm.apsp_distances_sharded(adj1, m)
-    before = pm._apsp_sharded_fn.cache_info()
-    pm.apsp_distances_sharded(adj2, m)  # new values, same (mesh, V)
-    after = pm._apsp_sharded_fn.cache_info()
+    pa.apsp_distances_sharded(adj1, m)
+    before = pa._apsp_sharded_fn.cache_info()
+    pa.apsp_distances_sharded(adj2, m)  # new values, same (mesh, V)
+    after = pa._apsp_sharded_fn.cache_info()
     assert after.hits == before.hits + 1
     assert after.misses == before.misses
 
 
-def test_sharded_adaptive_packed_matches_unpacked():
+def test_sharded_adaptive_packed_matches_unpacked(virtual_mesh):
     """route_adaptive_sharded(packed=True) + host decode_segments must
     reproduce the sharded device-decoded nodes exactly — the mesh twin
     of the single-device packed-readback contract (engine's mesh branch
     ships slots, not node rows, per host)."""
     from sdnmpi_tpu.oracle.adaptive import decode_segments
-    from sdnmpi_tpu.parallel.mesh import route_adaptive_sharded
+    from sdnmpi_tpu.shardplane import route_adaptive_sharded
     from sdnmpi_tpu.topogen import dragonfly
 
     spec = dragonfly(4, 4)
     db = spec.to_topology_db(backend="jax", pad_multiple=8)
     t = tensorize(db, pad_multiple=8)
-    mesh = make_mesh(N_SHARDS)
+    mesh = virtual_mesh
     rng = np.random.default_rng(5)
     f = 64  # divides 8 shards
     src = rng.integers(0, t.n_real, f).astype(np.int32)

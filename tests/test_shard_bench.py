@@ -1,0 +1,90 @@
+"""Config 13 (pod-scale shard bench) machinery at test scale.
+
+The committed-suite regression gate (benchmarks/run.py
+--regression-gate) pins config 13's vs_baseline on real hardware; these
+fences pin the QUALITY side without a TPU: the sharded program must
+reproduce the single-chip engine's slots exactly, and the
+occupancy-bucketed twin must reproduce the padded computation exactly.
+A shard-quality regression (wrong routes, congestion drift, broken
+occupancy slicing) fails CI here before it can burn a TPU suite.
+"""
+
+import numpy as np
+
+from benchmarks.config13_shard import build, occ_args, validate
+from tests.conftest import N_VIRTUAL_DEVICES
+
+
+def test_sharded_primary_matches_single_chip(virtual_mesh):
+    """The primary row's sharded program == route_collective at test
+    scale (fattree k=4, 8-rank alltoall, virtual mesh), and the quality
+    ratio the bench gates on is computable and sane."""
+    from benchmarks.common import naive_single_path_load
+    from sdnmpi_tpu.oracle.adaptive import link_loads
+    from sdnmpi_tpu.oracle.dag import (
+        route_collective,
+        slots_to_nodes,
+        unpack_result,
+    )
+    from sdnmpi_tpu.shardplane import route_collective_sharded
+
+    spec, t, args, kw, usrc, udst, weight, _ = build(
+        4, 8, 8, N_VIRTUAL_DEVICES
+    )
+    buf = route_collective(*args, max_degree=t.max_degree, **kw)
+    slots_1, maxc_1 = unpack_result(np.asarray(buf), len(usrc), kw["max_len"])
+
+    slots_s, maxc_s = route_collective_sharded(*args, mesh=virtual_mesh, **kw)
+    np.testing.assert_array_equal(np.asarray(slots_s), slots_1)
+    np.testing.assert_allclose(float(maxc_s), maxc_1, rtol=1e-5)
+    validate(t, usrc, udst, np.asarray(slots_s))
+
+    # the gated ratio: balanced spread must not lose to naive routing
+    v = t.adj.shape[0]
+    live = usrc >= 0
+    nodes = slots_to_nodes(
+        np.asarray(t.adj), usrc, np.asarray(slots_s), dst=udst, complete=True
+    )
+    load = link_loads(nodes, weight, v)
+    naive = naive_single_path_load(
+        t.adj, kw["dist"], usrc[live], udst[live], weight[live],
+        kw["max_len"], v,
+    )
+    assert load.max() > 0
+    assert naive.max() / load.max() >= 1.0
+
+
+def test_padding_tax_twin_bucketed_matches_padded():
+    """The padding_tax row's fence: the occupied-bucket slice computes
+    the same slots as the fully-padded tensors (fattree k=4 padded 8x
+    past its 20 switches — the config-6b shape in miniature)."""
+    from sdnmpi_tpu.oracle.apsp import occ_bucket
+    from sdnmpi_tpu.oracle.dag import route_collective, unpack_result
+
+    spec, t, args, kw, usrc, udst, weight, _ = build(4, 64, 8, 1)
+    v = t.adj.shape[0]
+    v_occ = occ_bucket(t.n_real, v, 8)
+    assert t.n_real <= v_occ < v
+    args_occ, kw_occ = occ_args(t, args, kw, v_occ)
+
+    buf_pad = route_collective(*args, max_degree=t.max_degree, **kw)
+    slots_pad, _ = unpack_result(np.asarray(buf_pad), len(usrc), kw["max_len"])
+    buf_occ = route_collective(*args_occ, max_degree=t.max_degree, **kw_occ)
+    slots_occ, _ = unpack_result(np.asarray(buf_occ), len(usrc), kw["max_len"])
+    np.testing.assert_array_equal(slots_occ, slots_pad)
+    validate(t, usrc, udst, slots_occ)
+
+
+def test_config13_registered_and_schema_checked():
+    """run.py runs config 13 with the others, and a row shaped like its
+    emissions passes the suite schema the CI gate enforces."""
+    from benchmarks.run import CONFIGS, check_rows
+
+    assert any(name == "13" for name, _ in CONFIGS)
+    rows = [
+        {"config": "13", "metric": "alltoall8192_fattree4096_shard_route_ms",
+         "value": 1.0, "unit": "ms", "vs_baseline": 2.0, "mesh_devices": 8},
+        {"config": "13b", "metric": "alltoall8192_v2048pad_bucketed_route_ms",
+         "value": 1.0, "unit": "ms", "vs_baseline": 1.8, "v_occ": 1280},
+    ]
+    assert check_rows(rows) == []

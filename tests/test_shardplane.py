@@ -1,0 +1,395 @@
+"""Pod-scale shardplane fences (sdnmpi_tpu/shardplane, ISSUE 9).
+
+Everything here runs on the shared 8-device virtual CPU mesh
+(tests/conftest.virtual_mesh), so tier-1 exercises every sharded code
+path without hardware:
+
+- APSP bit-identity: sharded distances AND next hops equal the
+  single-chip oracle's on every generator topology.
+- Routing entry-point bit-identity: shortest / balanced / adaptive /
+  scheduled-phased collectives through ``Config.shard_oracle`` match
+  the single-chip backend exactly (idle fabrics: dyadic splits,
+  global-flow-id hash streams).
+- Occupancy-bucketed block kernels: the padded-capacity and
+  occupied-bucket computations are bit-identical (the config-6b
+  padding-tax fence at test scale).
+- Trace hygiene: a pow2 ladder of flow-batch sizes and two V shapes
+  compile a bounded set of sharded programs and then stop recompiling.
+- Packed readback: a sharded window's host-ward bytes scale with the
+  occupied flow count and hop budget, never F_padded x V.
+- ``shard_oracle`` default-off leaves the single-chip oracle untouched.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.topogen import fattree, linear, torus
+from tests.conftest import N_VIRTUAL_DEVICES
+
+TOPOS = {
+    "linear": lambda: linear(10, hosts_per_switch=2),
+    "fattree": lambda: fattree(4),
+    "torus": lambda: torus((2, 2, 2), hosts_per_switch=2),
+}
+
+
+def _db(spec, shard: bool):
+    db = spec.to_topology_db(backend="jax", pad_multiple=8)
+    if shard:
+        db.mesh_devices = N_VIRTUAL_DEVICES
+        db.shard_oracle = True
+    return db
+
+
+def _pairs(db, n_macs: int = 10):
+    macs = sorted(db.hosts)[:n_macs]
+    return [(a, b) for a in macs for b in macs if a != b]
+
+
+# -- APSP ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_sharded_apsp_bit_identical(topo, virtual_mesh):
+    """Row-sharded distances + next hops == the single-chip refresh on
+    every generator topology (the tensor half of the oracle fence)."""
+    spec = TOPOS[topo]()
+    oracles = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        oracle = db._jax_oracle()
+        oracle.refresh(db)
+        oracles[shard] = oracle
+    np.testing.assert_array_equal(
+        np.asarray(oracles[False]._dist_d), np.asarray(oracles[True]._dist_d)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(oracles[False]._next_d), np.asarray(oracles[True]._next_d)
+    )
+
+
+def test_sharded_apsp_survives_churn(virtual_mesh):
+    """A link delete + full re-refresh through the shardplane equals the
+    single-chip recompute (the refresh path churn recovery rides)."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = TOPOS["fattree"]()
+    oracles = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        oracle = db._jax_oracle()
+        oracle.refresh(db)
+        a = next(iter(db.links))
+        b = next(iter(db.links[a]))
+        for x, y in ((a, b), (b, a)):
+            db.delete_link(Link(Port(x, db.links[x][y].src.port_no),
+                                Port(y, db.links[x][y].dst.port_no)))
+        oracle.delta_repair_threshold = 0  # force the full sharded path
+        oracle.refresh(db)
+        oracles[shard] = oracle
+    np.testing.assert_array_equal(
+        np.asarray(oracles[False]._dist_d), np.asarray(oracles[True]._dist_d)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(oracles[False]._next_d), np.asarray(oracles[True]._next_d)
+    )
+
+
+# -- routing entry points ----------------------------------------------
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_shortest_batch_bit_identical(topo, virtual_mesh):
+    """find_routes_batch (the flow-sharded batch_fdb leg) — forced onto
+    the device path by shrinking the host-chase budget."""
+    spec = TOPOS[topo]()
+    results = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        db._jax_oracle().host_chase_hop_budget = 0  # device leg, always
+        results[shard] = db.find_routes_batch(_pairs(db))
+    assert results[False] == results[True]
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_balanced_batch_bit_identical(topo, virtual_mesh):
+    """find_routes_batch_balanced through the sharded DAG engine."""
+    spec = TOPOS[topo]()
+    results = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        results[shard] = db.find_routes_batch_balanced(
+            _pairs(db), dag_threshold=1, ecmp_ways=2
+        )
+    assert results[False][0] == results[True][0]
+    assert abs(results[False][1] - results[True][1]) < 1e-5
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_adaptive_batch_bit_identical(topo, virtual_mesh):
+    """find_routes_batch_adaptive through the mesh UGAL leg (idle
+    fabric: exact parity, per the shardplane contract)."""
+    spec = TOPOS[topo]()
+    results = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        results[shard] = db.find_routes_batch_adaptive(
+            _pairs(db), link_util={}
+        )
+    assert results[False][0] == results[True][0]
+    assert results[False][1] == results[True][1]
+
+
+def test_phased_collective_bit_identical(virtual_mesh):
+    """A scheduled phased collective (ISSUE 8's program shape) routes
+    identically over the shardplane: same pair->phase assignment, same
+    per-phase routes."""
+    spec = TOPOS["fattree"]()
+    programs = {}
+    for shard in (False, True):
+        db = _db(spec, shard)
+        macs = sorted(db.hosts)[:12]
+        pairs = [(a, b) for a in range(12) for b in range(12) if a != b]
+        src_idx = np.array([p[0] for p in pairs], np.int32)
+        dst_idx = np.array([p[1] for p in pairs], np.int32)
+        program = db.find_routes_collective_phased(
+            macs, src_idx, dst_idx, policy="balanced", n_phases=2,
+        )
+        program.reap_all()
+        programs[shard] = program
+    p0, p8 = programs[False], programs[True]
+    np.testing.assert_array_equal(p0.pair_phase, p8.pair_phase)
+    assert len(p0.phases) == len(p8.phases)
+    for ph0, ph8 in zip(p0.phases, p8.phases):
+        r0, r8 = ph0.window.reap(), ph8.window.reap()
+        np.testing.assert_array_equal(r0.pair_sub, r8.pair_sub)
+        np.testing.assert_array_equal(r0.hop_dpid, r8.hop_dpid)
+        np.testing.assert_array_equal(r0.hop_port, r8.hop_port)
+        np.testing.assert_array_equal(r0.hop_len, r8.hop_len)
+
+
+@pytest.mark.parametrize("wire", [False, True])
+def test_controller_collective_bit_identical(wire, virtual_mesh):
+    """The whole control plane (sim fabric; byte-level OF 1.0 codec
+    when wire=True): a block-installed alltoall under shard_oracle
+    rides the same switches/links and delivers on the data plane,
+    bit-identical to the single-chip controller."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+    from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+    n_ranks = 8
+    installs = {}
+    for shard in (False, True):
+        spec = fattree(4)
+        fabric = spec.to_fabric(wire=wire)
+        config = Config(
+            block_install_threshold=1,
+            mesh_devices=N_VIRTUAL_DEVICES if shard else 0,
+            shard_oracle=shard,
+        )
+        controller = Controller(fabric, config)
+        controller.attach()
+        macs = sorted(fabric.hosts)[:n_ranks]
+        for rank, mac in enumerate(macs):
+            fabric.hosts[mac].send(of.Packet(
+                eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff",
+                eth_type=of.ETH_TYPE_IP, ip_proto=of.IPPROTO_UDP,
+                udp_dst=config.announcement_port,
+                payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+            ))
+        vmac = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+        fabric.hosts[macs[0]].send(of.Packet(
+            eth_src=macs[0], eth_dst=vmac, eth_type=of.ETH_TYPE_IP,
+        ))
+        table = controller.router.collectives
+        assert len(table) == 1
+        install = next(iter(table))
+        # data plane: a sample pair delivers through the block flows
+        before = len(fabric.hosts[macs[2]].received)
+        fabric.hosts[macs[1]].send(of.Packet(
+            eth_src=macs[1],
+            eth_dst=VirtualMac(CollectiveType.ALLTOALL, 1, 2).encode(),
+            eth_type=of.ETH_TYPE_IP,
+        ))
+        assert len(fabric.hosts[macs[2]].received) > before
+        installs[shard] = install
+    i0, i8 = installs[False], installs[True]
+    assert i0.n_pairs == i8.n_pairs and i0.n_flows == i8.n_flows
+    assert i0.switches == i8.switches
+    assert i0.links == i8.links
+
+
+def test_shard_oracle_default_off_is_single_chip():
+    """Config default + a bare RouteOracle leave the shardplane cold:
+    no mesh, no sharded kernels — the byte-identical single-chip path."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.oracle.engine import RouteOracle
+
+    assert Config().shard_oracle is False
+    oracle = RouteOracle()
+    assert oracle.shard_oracle is False and oracle._shard_mesh() is None
+    # shard_oracle without a mesh is refused, not half-engaged
+    assert RouteOracle(shard_oracle=True).shard_oracle is False
+
+
+# -- occupancy-bucketed block kernels ----------------------------------
+
+
+def test_occupancy_apsp_bit_identical():
+    """Distances + next hops computed on the occupied bucket equal the
+    full padded-capacity kernels (the analytic padding block)."""
+    from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops, occ_bucket
+    from sdnmpi_tpu.oracle.engine import tensorize
+
+    spec = fattree(4)
+    db = spec.to_topology_db(backend="jax", pad_multiple=64)
+    t = tensorize(db, pad_multiple=64)
+    v = t.adj.shape[0]
+    b = occ_bucket(t.n_real, v, 8)
+    assert t.n_real <= b < v
+    d_full = apsp_distances(t.adj)
+    d_occ = apsp_distances(t.adj, n_occ=b)
+    np.testing.assert_array_equal(np.asarray(d_full), np.asarray(d_occ))
+    n_full = apsp_next_hops(t.adj, d_full, max_degree=t.max_degree)
+    n_occ = apsp_next_hops(t.adj, d_occ, max_degree=t.max_degree, n_occ=b)
+    np.testing.assert_array_equal(np.asarray(n_full), np.asarray(n_occ))
+
+
+def test_occ_bucket_ladder():
+    from sdnmpi_tpu.oracle.apsp import occ_bucket
+
+    assert occ_bucket(980, 2048, 128) == 1024
+    assert occ_bucket(1280, 2048, 128) == 1280
+    assert occ_bucket(20, 24, 8) == 24  # bucket reaches V: occupancy off
+    assert occ_bucket(20, 2048, 0) == 2048  # 0 disables
+    assert occ_bucket(0, 2048, 128) == 2048
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_occupancy_routes_bit_identical(shard, virtual_mesh):
+    """The engine's occupancy-bucketed DAG view routes identically to
+    the full padded computation, single-chip AND sharded — the
+    config-6b padding-tax fence at test scale."""
+    spec = fattree(4)
+    results = {}
+    for occ in (0, 8):
+        db = _db(spec, shard)
+        db._jax_oracle().occ_bucket_multiple = occ
+        # pad far past the 20 occupied switches so bucketing engages
+        db.pad_multiple = 64
+        db._jax_oracle().pad_multiple = 64
+        results[occ] = db.find_routes_batch_balanced(
+            _pairs(db, 12), dag_threshold=1, ecmp_ways=2
+        )
+    assert results[0][0] == results[8][0]
+    assert abs(results[0][1] - results[8][1]) < 1e-5
+
+
+# -- trace hygiene ------------------------------------------------------
+
+
+def test_sharded_trace_counts_bounded(virtual_mesh):
+    """A pow2 ladder of flow-batch sizes over two V shapes compiles a
+    bounded set of sharded programs; repeating the whole ladder adds
+    ZERO traces (the steady-state no-recompile contract)."""
+    from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+    def run_ladder(db):
+        macs = sorted(db.hosts)
+        oracle = db._jax_oracle()
+        oracle.host_chase_hop_budget = 0  # keep every window on device
+        for n in (3, 6, 12, 20):
+            macs_n = macs[: max(2, n)]
+            pairs = [(a, b) for a in macs_n for b in macs_n if a != b][:n * 4]
+            db.find_routes_batch(pairs)
+            db.find_routes_batch_balanced(pairs, dag_threshold=1, ecmp_ways=2)
+
+    dbs = [
+        _db(linear(10, hosts_per_switch=2), True),
+        _db(fattree(4), True),  # second V shape
+    ]
+    for db in dbs:
+        run_ladder(db)
+    warm = {
+        k: TRACE_COUNTS[k]
+        for k in ("shard_batch_fdb", "shard_apsp", "shard_next_hops")
+    }
+    assert warm["shard_batch_fdb"] > 0  # the sharded leg actually ran
+    assert warm["shard_apsp"] > 0 and warm["shard_next_hops"] > 0
+    for db in dbs:
+        run_ladder(db)  # same shapes again: every program is cached
+    for k, v in warm.items():
+        assert TRACE_COUNTS[k] == v, f"{k} recompiled on a warm ladder"
+
+
+# -- packed readback ----------------------------------------------------
+
+
+def test_sharded_window_readback_packed(virtual_mesh):
+    """Bytes moved host-ward by a sharded window reap are proportional
+    to the occupied pair count x hop budget and INDEPENDENT of fabric
+    capacity — never the F_padded x V gather the shardplane contract
+    forbids. Proven by inflating V 21x and asserting the reaped window
+    ships the exact same bytes."""
+    from sdnmpi_tpu.shardplane import window_readback_nbytes
+    from sdnmpi_tpu.topogen import fattree
+
+    sizes = {}
+    for pad in (8, 512):
+        db = fattree(4).to_topology_db(backend="jax", pad_multiple=pad)
+        db.mesh_devices = N_VIRTUAL_DEVICES
+        db.shard_oracle = True
+        oracle = db._jax_oracle()
+        oracle.host_chase_hop_budget = 0  # keep the window on device
+        oracle.occ_bucket_multiple = 0  # no occupancy help: the packed
+        # readback must hold at full padded capacity
+        pairs = _pairs(db, 12)
+        wr = db.find_routes_batch_dispatch(pairs).reap()
+        assert (wr.hop_len > 0).all()
+        width = wr.hop_dpid.shape[1]
+        nbytes = window_readback_nbytes(wr)
+        # struct arrays: int64 dpid + int32 port per hop slot + int32 len
+        assert nbytes <= len(pairs) * (width * 12 + 4)
+        sizes[pad] = nbytes
+    assert sizes[8] == sizes[512], "readback bytes must not scale with V"
+    assert sizes[512] < len(pairs) * 512 * 4  # far under one [F, V] gather
+    # the adaptive mesh leg ships int8 slot streams, not node rows —
+    # the other packed contract (pinned in test_mesh_dag as well)
+    fdbs, _, _ = db.find_routes_batch_adaptive(pairs, link_util={})
+    assert fdbs[0]
+
+
+# -- telemetry ----------------------------------------------------------
+
+
+def test_shard_metrics_and_span(virtual_mesh):
+    """The sharded legs feed shard_dispatch/reap histograms, the mesh
+    gauge, and open a shard_dispatch child span under the ambient
+    span — the flight-recorder attribution path."""
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+    from sdnmpi_tpu.utils import tracing
+
+    records = []
+    tracing.add_trace_sink(records.append)
+    try:
+        h_d = REGISTRY.histogram("shard_dispatch_seconds")
+        h_r = REGISTRY.histogram("shard_reap_seconds")
+        d0, r0 = h_d.count, h_r.count
+        db = _db(fattree(4), True)
+        db._jax_oracle().host_chase_hop_budget = 0
+        parent = tracing.start_span("route_window", n_pairs=1)
+        db.find_routes_batch_dispatch(_pairs(db)).reap()
+        parent.end()
+        assert h_d.count > d0 and h_r.count > r0
+        assert REGISTRY.get("shard_mesh_devices").value == N_VIRTUAL_DEVICES
+        spans = [r for r in records if r.get("kind") == "span"]
+        shard = [r for r in spans if r["name"] == "shard_dispatch"]
+        window = [r for r in spans if r["name"] == "route_window"]
+        assert shard and window
+        assert shard[0]["parent"] == window[0]["span"]
+        assert shard[0]["mesh_devices"] == N_VIRTUAL_DEVICES
+    finally:
+        tracing.remove_trace_sink(records.append)
