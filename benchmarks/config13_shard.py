@@ -1,6 +1,7 @@
-"""Config 13: pod-scale sharded oracle (sdnmpi_tpu/shardplane, ISSUE 9).
+"""Config 13: pod-scale sharded oracle (sdnmpi_tpu/shardplane, ISSUE 9)
+plus the ring-exchange twin (ISSUE 10).
 
-Two datapoints:
+Three datapoints:
 
 - **Primary**: 8192-rank MPI_Alltoall on a fat-tree k=56 (3,920
   switches, padded to the mesh multiple — the ~4096-switch fabric of
@@ -19,6 +20,11 @@ Two datapoints:
   vs_baseline = old full-padded ms / new bucketed ms — the committed
   gate pins the padding tax staying retired (>= ~1.6x here means the
   2x tax of BASELINE config 6b is down to <= 1.25x).
+- **ring_exchange twin** (row 13c): the shardplane refresh with the
+  distance exchange on the XLA blocking all-gather (the PR-9 leg) vs
+  the ring-DMA-overlapped kernels (``measure_ring_exchange``);
+  vs_baseline = gather / ring, with exchange-bytes and overlap-gain
+  columns and the ``shard_exchange_overlap_gain`` gauge recorded.
 
 Reported value: steady-state per-collective route latency (pipelined
 stream, like bench.py). Both rows decode + validate the sampled paths
@@ -137,6 +143,115 @@ def validate(t, usrc, udst, slots) -> None:
         assert (adj[p[:-1], p[1:]] > 0).all(), f"flow {f} rides a non-link"
 
 
+def measure_ring_exchange(adj, max_degree: int, mesh, warmup: int = 1,
+                          iters: int = 5) -> dict:
+    """The ring_exchange twin's measurements at one shape (ISSUE 10),
+    shared by the bench row and the test-scale fence
+    (tests/test_shard_bench.py):
+
+    - ``gather_ms``: the PR-9 refresh leg — row-sharded BFS output
+      re-replicated through XLA's blocking f32 all-gather, then the
+      degree-compact next-hop argmin.
+    - ``ring_ms``: the same refresh with the exchange streamed through
+      the bidirectional ring (bf16 wire) and the argmin consuming
+      column blocks as they arrive (``apsp_next_hops_ringed``).
+    - ``overlap_gain``: serial-equivalent wall over the overlapped
+      wall — the config-10 overlap_gain idiom applied to the exchange
+      leg. Serial-equivalent = the ring's OWN transport run to
+      completion (standalone bf16 ring exchange) + the argmin on
+      pre-replicated distances; overlapped = the pipelined kernel.
+      Keeping the transport fixed isolates exactly what pipelining
+      hides (comparing against the f32 XLA gather would confound
+      transport speed with overlap — both appear as columns anyway).
+      Recorded to the ``shard_exchange_overlap_gain`` gauge.
+    - ``exchange_bytes``: per-device wire bytes of one ring exchange
+      (bf16 — half the f32 the XLA gather moves).
+
+    The two refresh legs are asserted bit-identical before any number
+    is reported — a silently-wrong exchange fails the config.
+    """
+    import jax
+
+    from benchmarks.common import time_fn
+    from sdnmpi_tpu.kernels import ring as ringk
+    from sdnmpi_tpu.oracle.engine import note_exchange_overlap
+    from sdnmpi_tpu.shardplane import (
+        apsp_distances_rowsharded,
+        apsp_next_hops_ringed,
+        apsp_next_hops_rowsharded,
+        mesh_shards,
+    )
+    from sdnmpi_tpu.shardplane.mesh import P, mesh_axes, shard_map
+
+    v = adj.shape[0]
+    s = mesh_shards(mesh)
+    dist_sh = jax.block_until_ready(apsp_distances_rowsharded(adj, mesh))
+
+    # bit-identity fence first: the ring-streamed argmin must equal the
+    # gather-then-argmin kernel exactly
+    n_gather = apsp_next_hops_rowsharded(adj, dist_sh, mesh, max_degree)
+    n_ring = apsp_next_hops_ringed(adj, dist_sh, mesh, max_degree)
+    np.testing.assert_array_equal(np.asarray(n_gather), np.asarray(n_ring))
+
+    t_gather = time_fn(
+        lambda: jax.block_until_ready(
+            apsp_next_hops_rowsharded(adj, dist_sh, mesh, max_degree)
+        ),
+        warmup=warmup, iters=iters,
+    )
+    t_ring = time_fn(
+        lambda: jax.block_until_ready(
+            apsp_next_hops_ringed(adj, dist_sh, mesh, max_degree)
+        ),
+        warmup=warmup, iters=iters,
+    )
+
+    # serial-equivalent decomposition: the blocking exchange alone
+    # (the f32 XLA all-gather the gather leg embeds) + the consumer
+    # computing on already-replicated distances
+    import functools as ft
+
+    from jax import lax
+
+    axes = mesh_axes(mesh)
+    xla_gather = jax.jit(ft.partial(
+        shard_map,
+        mesh=mesh, in_specs=P(axes, None), out_specs=P(None, None),
+        check_vma=False,
+    )(lambda b: lax.all_gather(b, axes, axis=0, tiled=True)))
+    t_exchange = time_fn(
+        lambda: jax.block_until_ready(xla_gather(dist_sh)),
+        warmup=warmup, iters=iters,
+    )
+    dist_rep = jax.block_until_ready(xla_gather(dist_sh))
+    t_consume = time_fn(
+        lambda: jax.block_until_ready(
+            apsp_next_hops_rowsharded(adj, dist_rep, mesh, max_degree)
+        ),
+        warmup=warmup, iters=iters,
+    )
+    t_ring_exchange = time_fn(
+        lambda: jax.block_until_ready(
+            ringk.exchange_distances(dist_sh, mesh)
+        ),
+        warmup=warmup, iters=iters,
+    )
+    from sdnmpi_tpu.oracle.engine import _m_shard_exchange_s
+
+    _m_shard_exchange_s.observe(t_ring_exchange)
+    gain = note_exchange_overlap(t_ring_exchange + t_consume, t_ring)
+    return {
+        "gather_ms": t_gather * 1e3,
+        "ring_ms": t_ring * 1e3,
+        "exchange_ms": t_exchange * 1e3,
+        "ring_exchange_ms": t_ring_exchange * 1e3,
+        "consume_ms": t_consume * 1e3,
+        "overlap_gain": gain,
+        "exchange_bytes": ringk.exchange_bytes(v, v, s),
+        "mesh_devices": s,
+    }
+
+
 def main() -> None:
     import math
 
@@ -226,6 +341,23 @@ def main() -> None:
     emit(
         "alltoall8192_v2048pad_bucketed_route_ms", t_occ_ms, "ms",
         t_pad_ms / t_occ_ms, windows_ms=windows_occ, v_occ=v_occ,
+    )
+
+    # -- ring_exchange twin: gather refresh vs ring-DMA-overlapped --------
+    m = measure_ring_exchange(t.adj, t.max_degree, mesh)
+    log(
+        f"ring twin: gather refresh {m['gather_ms']:.2f} ms vs ring "
+        f"{m['ring_ms']:.2f} ms (exchange {m['exchange_ms']:.2f} ms f32 "
+        f"gather / {m['ring_exchange_ms']:.2f} ms bf16 ring, consume "
+        f"{m['consume_ms']:.2f} ms, overlap gain {m['overlap_gain']:.2f}x, "
+        f"{m['exchange_bytes'] / 1e6:.1f} MB wire)"
+    )
+    emit(
+        "fattree4096_ring_refresh_ms", m["ring_ms"], "ms",
+        m["gather_ms"] / m["ring_ms"],
+        exchange_bytes=m["exchange_bytes"],
+        overlap_gain=round(m["overlap_gain"], 3),
+        mesh_devices=m["mesh_devices"],
     )
 
 

@@ -8,7 +8,16 @@ timeout 900 python bench.py || exit 2
 timeout 1800 python -m benchmarks.run 6 7 || exit 3
 # mesh smoke: the sharded oracle leg (config 13 sizes its mesh to
 # whatever the host exposes — real chips here, the virtual CPU mesh on
-# a dev box — so the shardplane program runs on every validation pass)
+# a dev box — so the shardplane program runs on every validation pass;
+# since ISSUE 10 the config also emits the ring_exchange twin row, so
+# the ring-DMA-overlapped refresh runs --ring-exchange-equivalent here)
 timeout 1800 python -m benchmarks.run 13 || exit 4
+# ring-exchange smoke: the Pallas DMA ring kernel for real on the
+# slice's mesh (tests/test_ring.py runs the same kernel under the
+# interpreter on the virtual mesh everywhere else), plus a live
+# --ring-exchange controller pass through the launch flags
+SDNMPI_TEST_TPU=1 timeout 900 python -m pytest tests/test_ring.py -q || exit 5
+timeout 600 python -m sdnmpi_tpu --topo fattree:8 --mesh-devices 4 \
+  --shard-oracle --ring-exchange --demo --demo-ranks 8 --duration 5 || exit 6
 timeout 900 python -m benchmarks.profile_stages fattree:32 128 || true
 timeout 900 python -m benchmarks.profile_stages torus:6,6,6 128 || true

@@ -118,6 +118,22 @@ class Config:
     #: OFF: the single-chip oracle path is byte-identical to the
     #: pre-shardplane controller (pinned by tests/test_shardplane.py).
     shard_oracle: bool = False
+    #: communication-overlapped shardplane exchange (ISSUE 10,
+    #: kernels/ring.py): replace the blocking XLA all-gather that
+    #: re-replicates the row-sharded [V, V] distance/next-hop tensors
+    #: with the double-buffered bidirectional ring exchange (Pallas
+    #: ``make_async_remote_copy`` DMA on a real TPU mesh; the ppermute
+    #: twin elsewhere) and block-pipelined consumers — the refresh's
+    #: degree-compact next-hop argmin, the shortest-path hop chases,
+    #: and the DAG collective engine consume each arriving [V/s, V]
+    #: block while the next is in flight, with distances packed to
+    #: bf16 for the wire (bit-exact for hop counts <= 256 — every
+    #: generator topology) and next hops to int16. Requires
+    #: ``shard_oracle`` (ignored with a warning otherwise). Default
+    #: OFF: the XLA-gather shardplane path is byte-identical to PR 9,
+    #: and with the knob ON routes stay bit-identical to it
+    #: (tests/test_shardplane.py pins both).
+    ring_exchange: bool = False
     #: rank-pair count at or above which a proactive collective install
     #: uses the array-native block path (int MAC keys, shared
     #: FlowPathBlocks, one event per collective) instead of the

@@ -64,6 +64,7 @@ class TopologyManager:
             max_diameter=config.max_diameter,
             mesh_devices=config.mesh_devices,
             shard_oracle=config.shard_oracle,
+            ring_exchange=config.ring_exchange,
             delta_repair_threshold=config.delta_repair_threshold,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed

@@ -120,6 +120,7 @@ class TopologyDB:
         max_diameter: int = 0,
         mesh_devices: int = 0,
         shard_oracle: bool = False,
+        ring_exchange: bool = False,
         delta_repair_threshold: Optional[int] = None,
     ) -> None:
         # dpid -> switch entity
@@ -138,6 +139,10 @@ class TopologyDB:
         #: over the mesh_devices mesh alongside the balanced/adaptive
         #: legs; False keeps the single-chip oracle byte-identical
         self.shard_oracle = shard_oracle
+        #: ring-DMA exchange + block-pipelined consumers on the
+        #: sharded legs (Config.ring_exchange, ISSUE 10); needs
+        #: shard_oracle, bit-identical routes either way
+        self.ring_exchange = ring_exchange
         #: max link deltas the oracle absorbs by in-place repair before
         #: a full recompute (None = RouteOracle's default; 0 disables)
         self.delta_repair_threshold = delta_repair_threshold
@@ -634,6 +639,7 @@ class TopologyDB:
                 self.pad_multiple, self.max_diameter,
                 mesh_devices=self.mesh_devices,
                 shard_oracle=self.shard_oracle,
+                ring_exchange=self.ring_exchange,
             )
             if self.delta_repair_threshold is not None:
                 self._oracle.delta_repair_threshold = (

@@ -1,12 +1,22 @@
 """Pallas TPU kernels for the oracle's hot ops."""
 
 from sdnmpi_tpu.kernels.bfs import bfs_distances_pallas, pallas_supported
+from sdnmpi_tpu.kernels.ring import (
+    exchange_distances,
+    ring_all_gather,
+    ring_stream,
+    ring_supported,
+)
 from sdnmpi_tpu.kernels.sampler import sample_slots_pallas, sampler_supported
 from sdnmpi_tpu.kernels.tiling import col_block
 
 __all__ = [
     "bfs_distances_pallas",
     "pallas_supported",
+    "exchange_distances",
+    "ring_all_gather",
+    "ring_stream",
+    "ring_supported",
     "sample_slots_pallas",
     "sampler_supported",
     "col_block",

@@ -1,0 +1,423 @@
+"""Bidirectional ring all-gather over the shardplane mesh (ISSUE 10).
+
+PR 9's sharded oracle row-shards the ``[V, V]`` distance/next-hop
+tensors but re-replicates them through XLA's blocking ``all-gather``
+before every consumer — at the pod shape (V≈4096) a ~64 MB f32
+exchange sitting serially on the critical path of each topology
+refresh. This module owns the exchange instead:
+
+- ``ring_all_gather``: a **double-buffered bidirectional ring**
+  all-gather. On a real TPU mesh it runs as a Pallas kernel built on
+  ``pltpu.make_async_remote_copy`` + DMA semaphores (the SNIPPETS.md
+  [2] pattern): each chip forwards blocks clockwise AND
+  counter-clockwise over the ICI neighbor links, double-buffering the
+  in-flight slot against the slot being copied out, so both directions
+  of every link carry payload every step — ceil((s-1)/2) steps instead
+  of s-1, at full bisection bandwidth. The same kernel runs under the
+  Pallas interpreter (``interpret=True``) on the virtual CPU mesh —
+  the interpret-mode twin tier-1 differentially fences against
+  ``lax.all_gather`` — and an XLA ``ppermute`` twin with the identical
+  schedule serves platforms without the Pallas TPU backend.
+- ``ring_stream``: the same bidirectional schedule as an in-body
+  driver for *consuming* kernels: each arriving block is handed to a
+  consume callback while the next block is in flight, which is how
+  the shardplane's block-pipelined consumers (shardplane/apsp.py,
+  shardplane/routes.py) hide the exchange behind the compute it feeds.
+- Wire packing: hop-count distances ride the ring as **bf16** — hop
+  counts are small exact integers (bf16 round-trips integers up to
+  ``WIRE_EXACT_MAX_HOPS`` and inf bit-exactly), so the wire carries
+  half the bytes of the f32 tensors XLA's all-gather moves, and the
+  unpacked matrix is bit-identical. Next-hop matrices ride as int16
+  (exact for every index while V < 2**15).
+
+Ring neighbor order is the mesh's flattened device order (row-major
+over its axes — the layout ``shard_map`` gives row blocks), addressed
+by logical device id, so the same schedule runs on the virtual CPU
+mesh, a single-host slice, and a multi-host mesh built by
+``shardplane.mesh.make_multihost_mesh`` (where the device order keeps
+each host's shard contiguous on the ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas TPU backend is optional at import time (CPU CI, interpret tests)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+#: largest hop count the bf16 wire format round-trips bit-exactly
+#: (bf16 has an 8-bit significand: every integer in [0, 256] and inf
+#: are representable). Fabrics whose V bounds the diameter inside this
+#: ride bf16; anything larger rides the int16 inf-sentinel wire — same
+#: 2 bytes, exact for EVERY hop count while V < 2**15 — so the packed
+#: exchange is never silently lossy (tests/test_ring.py pins both
+#: formats and the selection rule).
+WIRE_EXACT_MAX_HOPS = 256
+
+#: largest V the int16 wire formats cover exactly (hop counts and
+#: next-hop indices in [-1, V-1] must fit a signed 16-bit int)
+NEXT_WIRE_MAX_V = 1 << 15
+
+
+def dist_wire_dtype(v: int):
+    """Wire dtype for hop-count distances on a V-switch fabric: bf16
+    where V - 1 (the diameter's hard bound) provably sits in bf16's
+    exact-integer range, the int16 inf-sentinel format otherwise, f32
+    (no packing win) past the int16 bound. Static per V, so the jit
+    ladder is untouched."""
+    if v - 1 <= WIRE_EXACT_MAX_HOPS:
+        return jnp.bfloat16
+    if v <= NEXT_WIRE_MAX_V:
+        return jnp.int16
+    return jnp.float32
+
+
+def pack_dist_wire(dist: jax.Array, v: int | None = None) -> jax.Array:
+    """f32 hop-count distances -> 2-byte wire blocks (half the f32
+    all-gather's bytes), bit-exact: bf16 when the fabric's V bounds
+    every hop count inside bf16's integer range, else int16 with -1
+    standing in for inf. ``v`` is the FULL matrix's switch capacity
+    (hop counts are bounded by it, not by a slice's shape); defaults
+    to ``dist.shape[-1]`` for full-width rows."""
+    dt = dist_wire_dtype(dist.shape[-1] if v is None else v)
+    if dt == jnp.int16:
+        return jnp.where(jnp.isinf(dist), -1, dist).astype(jnp.int16)
+    return dist.astype(dt)
+
+
+def unpack_dist_wire(wire: jax.Array) -> jax.Array:
+    """Wire blocks -> f32 distances (the int16 format restores inf
+    from its -1 sentinel)."""
+    if wire.dtype == jnp.int16:
+        w = wire.astype(jnp.float32)
+        return jnp.where(w < 0, jnp.inf, w)
+    return wire.astype(jnp.float32)
+
+
+def pack_next_wire(nxt: jax.Array) -> jax.Array:
+    """int32 next-hop rows -> int16 wire (exact while V < 2**15; the
+    caller gates on NEXT_WIRE_MAX_V and keeps int32 past it)."""
+    return nxt.astype(jnp.int16)
+
+
+def unpack_next_wire(wire: jax.Array) -> jax.Array:
+    return wire.astype(jnp.int32)
+
+
+def ring_legs(n_shards: int) -> tuple[int, int]:
+    """(clockwise, counter-clockwise) hop counts of the bidirectional
+    ring: cw carries ceil((s-1)/2) hops, ccw the remaining floor, so
+    the two directions together deliver every remote block in
+    ceil((s-1)/2) steps."""
+    return (n_shards // 2, (n_shards - 1) // 2)
+
+
+def ring_perms(n_shards: int) -> tuple[list, list]:
+    """Static (cw, ccw) permutation lists over the flattened logical
+    device order 0..s-1 — the ring neighbor order. Derived from logical
+    ids only: the mesh's device order decides which physical chip each
+    id names (shardplane.mesh keeps hosts contiguous on multi-host
+    meshes, so most ring hops stay on-host/on-ICI)."""
+    cw = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    ccw = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    return cw, ccw
+
+
+def flat_shard_index(mesh) -> jax.Array:
+    """Flattened device index inside a shard_map body: row-major over
+    the mesh's axes, matching shard_map's row-block layout AND the
+    logical-id addressing of the Pallas remote copies."""
+    idx = jnp.int32(0)
+    for name in mesh.axis_names:
+        idx = idx * mesh.shape[name] + lax.axis_index(name)
+    return idx
+
+
+def arrival_steps(mesh) -> jax.Array:
+    """[s] int32: the ring step at which each shard's block reaches
+    this device (0 = our own block). Usable inside a shard_map body;
+    shards the cw leg cannot reach in its ceil((s-1)/2) hops arrive on
+    the ccw leg and vice versa."""
+    from sdnmpi_tpu.shardplane.mesh import mesh_shards
+
+    s = mesh_shards(mesh)
+    n_cw, n_ccw = ring_legs(s)
+    me = flat_shard_index(mesh)
+    q = jnp.arange(s, dtype=jnp.int32)
+    d_cw = (me - q) % s  # hops the cw leg needs to bring q's block here
+    d_ccw = (q - me) % s
+    via_cw = jnp.where(d_cw <= n_cw, d_cw, s)
+    via_ccw = jnp.where(d_ccw <= n_ccw, d_ccw, s)
+    return jnp.minimum(via_cw, via_ccw)
+
+
+def ring_stream(mesh, block: jax.Array, consume, carry):
+    """Drive the bidirectional ring from inside a shard_map body,
+    handing every shard's block to ``consume`` as it arrives.
+
+    ``block`` is this shard's wire block; ``consume(carry, blk,
+    src_shard, step) -> carry`` is called once per arriving block —
+    first for our own (step 0), then per ring step for the cw and ccw
+    arrivals. The ppermute for step t+1 is independent of step t's
+    consume, so the XLA latency-hiding scheduler overlaps the next
+    transfer with the consumer compute — the block-pipelined form the
+    shardplane kernels build on. Returns the final carry.
+    """
+    from sdnmpi_tpu.shardplane.mesh import mesh_axes, mesh_shards
+
+    axes = mesh_axes(mesh)
+    s = mesh_shards(mesh)
+    me = flat_shard_index(mesh)
+    n_cw, n_ccw = ring_legs(s)
+    perm_cw, perm_ccw = ring_perms(s)
+    carry = consume(carry, block, me, 0)
+    cw = ccw = block
+    for t in range(1, max(n_cw, n_ccw) + 1):
+        if t <= n_cw:
+            cw = lax.ppermute(cw, axes, perm_cw)
+        if t <= n_ccw:
+            ccw = lax.ppermute(ccw, axes, perm_ccw)
+        if t <= n_cw:
+            carry = consume(carry, cw, (me - t) % s, t)
+        if t <= n_ccw:
+            carry = consume(carry, ccw, (me + t) % s, t)
+    return carry
+
+
+def ring_supported(platform: str | None = None) -> bool:
+    """Whether the Pallas DMA kernel applies: TPU platform with the
+    Pallas TPU backend importable. Everything else (the virtual CPU
+    mesh, GPU) takes the ppermute twin — same schedule, same wire."""
+    if not _HAS_PLTPU:
+        return False
+    if platform is None:
+        platform = jax.default_backend()
+    return platform == "tpu"
+
+
+# -- the Pallas kernel --------------------------------------------------
+
+
+def _ring_gather_kernel(x_ref, o_ref, comm_ref, send_sem, recv_sem,
+                        cp_sem, *, s: int, b: int, axis_name: str,
+                        interpret: bool):
+    """One device's program: assemble all s row blocks into ``o_ref``.
+
+    ``comm_ref`` is a ``[2, 2, B, C]`` HBM scratch — direction (cw,
+    ccw) x double-buffer slot. Each step sends the block received last
+    step (our own block on step 1, straight from ``x_ref``) onward
+    while the previous slot's copy-out to ``o_ref`` proceeds; DMA
+    semaphores pair every send with the matching receive, and the
+    neighbor barrier up front keeps a fast device from writing into a
+    neighbor that has not entered the kernel yet."""
+    me = lax.axis_index(axis_name)
+    right = lax.rem(me + 1, s)
+    left = lax.rem(me + s - 1, s)
+    n_cw, n_ccw = ring_legs(s)
+
+    # our own rows: straight local DMA into the output slab
+    own = pltpu.make_async_copy(x_ref, o_ref.at[pl.ds(me * b, b)], cp_sem)
+    own.start()
+    own.wait()
+
+    # neighbor barrier before any remote write (a fast device must not
+    # land a block in a neighbor that has not entered the kernel); the
+    # interpreter serializes device programs itself and has no lowering
+    # for the global barrier semaphore, so it skips the handshake
+    if not interpret:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        pltpu.semaphore_wait(barrier, 2)
+
+    for t in range(1, max(n_cw, n_ccw) + 1):
+        slot = t % 2
+        prev = (t - 1) % 2
+        hops = []  # (direction, rdma, origin shard of the arriving block)
+        if t <= n_cw:  # clockwise: forward to the right neighbor
+            hops.append((0, pltpu.make_async_remote_copy(
+                src_ref=x_ref if t == 1 else comm_ref.at[0, prev],
+                dst_ref=comm_ref.at[0, slot],
+                send_sem=send_sem.at[0, slot],
+                recv_sem=recv_sem.at[0, slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ), lax.rem(me + s - t, s)))
+        if t <= n_ccw:  # counter-clockwise: forward to the left neighbor
+            hops.append((1, pltpu.make_async_remote_copy(
+                src_ref=x_ref if t == 1 else comm_ref.at[1, prev],
+                dst_ref=comm_ref.at[1, slot],
+                send_sem=send_sem.at[1, slot],
+                recv_sem=recv_sem.at[1, slot],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ), lax.rem(me + t, s)))
+        for _, rdma, _ in hops:  # both directions in flight before any wait
+            rdma.start()
+        for direction, rdma, origin in hops:
+            rdma.wait()
+            out = pltpu.make_async_copy(
+                comm_ref.at[direction, slot],
+                o_ref.at[pl.ds(origin * b, b)],
+                cp_sem,
+            )
+            out.start()
+            out.wait()
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_gather_pallas_fn(mesh, b: int, c: int, dtype_name: str,
+                           interpret: bool):
+    """Cached jitted shard_map'd pallas_call for one (mesh, block
+    shape, dtype) — rebuilt closures would recompile the multi-device
+    program per call (the same rule every shardplane builder follows)."""
+    from jax.sharding import Mesh
+
+    from sdnmpi_tpu.shardplane.mesh import P, mesh_shards, shard_map
+
+    s = mesh_shards(mesh)
+    dtype = jnp.dtype(dtype_name)
+    # the remote copies address devices by a SINGLE logical ring axis
+    # (the interpreter refuses multi-axis logical ids); a flattened
+    # companion mesh over the identical device order keeps the block
+    # layout byte-identical to the ("flow", "v") shard_map layout
+    flat_mesh = Mesh(mesh.devices.reshape(-1), ("ring",))
+    kernel = functools.partial(
+        _ring_gather_kernel, s=s, b=b, axis_name="ring",
+        interpret=interpret,
+    )
+    params = {}
+    cp = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cp is not None:
+        params["compiler_params"] = cp(collective_id=0)
+
+    def body(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((s * b, c), dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            scratch_shapes=[
+                pltpu.TPUMemorySpace.ANY((2, 2, b, c), dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.SemaphoreType.DMA,
+            ],
+            interpret=interpret,
+            **params,
+        )(x)
+
+    return jax.jit(shard_map(
+        body, mesh=flat_mesh, in_specs=P("ring", None),
+        out_specs=P(None, None), check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_gather_xla_fn(mesh, b: int, c: int, dtype_name: str):
+    """The ppermute twin: identical bidirectional schedule and block
+    placement, expressed as XLA collective-permutes (which ride the
+    same ICI neighbor links on hardware). This is the production path
+    off-TPU and the reference the Pallas kernel is fenced against."""
+    from sdnmpi_tpu.shardplane.mesh import (
+        P, mesh_axes, mesh_shards, shard_map,
+    )
+
+    axes = mesh_axes(mesh)
+    s = mesh_shards(mesh)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axes, None),
+        out_specs=P(None, None), check_vma=False,
+    )
+    def body(x):
+        out0 = jnp.zeros((s * b, c), x.dtype)
+
+        def consume(out, blk, src, _step):
+            return lax.dynamic_update_slice(out, blk, (src * b, 0))
+
+        return ring_stream(mesh, x, consume, out0)
+
+    return body
+
+
+def ring_all_gather(
+    x: jax.Array, mesh, *, interpret: bool = False,
+) -> jax.Array:
+    """All-gather the row-sharded ``[R, C]`` array over the mesh's
+    bidirectional ring; returns the replicated ``[R, C]``.
+
+    Dispatches to the Pallas DMA kernel on a real TPU mesh (or under
+    ``interpret=True`` anywhere — the interpreter emulates the remote
+    copies, which is how tier-1 exercises the kernel logic on CPU);
+    the ppermute twin otherwise. ``R`` need not divide the shard
+    count: the final uneven block is padded onto the wire and the
+    result trimmed (callers with shard-divisible tensors pay nothing).
+    Wire packing is the caller's business — pass bf16/int16 blocks to
+    halve the exchange bytes (pack_dist_wire/pack_next_wire).
+    """
+    from sdnmpi_tpu.shardplane.mesh import mesh_shards
+
+    r, c = x.shape
+    s = mesh_shards(mesh)
+    if s == 1:
+        return x
+    rp = ((r + s - 1) // s) * s
+    if rp != r:
+        x = jnp.concatenate(
+            [x, jnp.zeros((rp - r, c), x.dtype)], axis=0
+        )
+    b = rp // s
+    if (ring_supported() or interpret) and _HAS_PLTPU:
+        fn = _ring_gather_pallas_fn(mesh, b, c, x.dtype.name, interpret)
+    else:
+        # no Pallas backend importable: the ppermute twin is the same
+        # schedule and bit-identical, so interpret requests degrade to
+        # it instead of dereferencing the failed import
+        fn = _ring_gather_xla_fn(mesh, b, c, x.dtype.name)
+    out = fn(x)
+    return out[:r] if rp != r else out
+
+
+def exchange_distances(
+    dist: jax.Array, mesh, *, interpret: bool = False
+) -> jax.Array:
+    """The distance exchange: row-sharded f32 hop counts -> replicated
+    f32, packed to bf16 for the wire (bit-identical for hop counts
+    within WIRE_EXACT_MAX_HOPS — every generator topology)."""
+    return unpack_dist_wire(
+        ring_all_gather(pack_dist_wire(dist), mesh, interpret=interpret)
+    )
+
+
+def exchange_bytes(v_rows: int, n_cols: int, n_shards: int,
+                   itemsize: int = 2) -> int:
+    """Per-device wire bytes one full ring exchange moves: every
+    remote block crosses this device once ((s-1)/s of the matrix),
+    counted at the wire item size (bf16/int16 = 2). The bench's
+    exchange-bytes column and the shard_exchange span report this."""
+    if n_shards <= 1:
+        return 0
+    block = -(-v_rows // n_shards)
+    return (n_shards - 1) * block * n_cols * itemsize

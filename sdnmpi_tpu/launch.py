@@ -123,6 +123,7 @@ def config_from_args(args) -> Config:
         flow_hard_timeout=args.flow_hard_timeout,
         mesh_devices=args.mesh_devices,
         shard_oracle=getattr(args, "shard_oracle", False),
+        ring_exchange=getattr(args, "ring_exchange", False),
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
@@ -145,8 +146,34 @@ def config_from_args(args) -> Config:
     )
 
 
+def parse_distributed(spec: str) -> tuple[str, int, int]:
+    """'HOST:PORT,NPROC,RANK' -> (coordinator, n_processes, process_id)
+    for shardplane.mesh.init_multihost; raises SystemExit on malformed
+    input so a typo fails the launch instead of silently running
+    single-host."""
+    try:
+        coordinator, nproc_s, rank_s = spec.rsplit(",", 2)
+        nproc, rank = int(nproc_s), int(rank_s)
+    except ValueError:
+        raise SystemExit(
+            f"--distributed wants HOST:PORT,NPROC,RANK (got {spec!r})"
+        )
+    if ":" not in coordinator or nproc < 1 or not 0 <= rank < nproc:
+        raise SystemExit(
+            f"--distributed wants HOST:PORT,NPROC,RANK with "
+            f"0 <= RANK < NPROC (got {spec!r})"
+        )
+    return coordinator, nproc, rank
+
+
 async def amain(args) -> None:
     listen = getattr(args, "listen", None)
+    if getattr(args, "distributed", None):
+        # multi-host mesh: the distributed runtime must exist before
+        # any mesh (or jax computation) is built
+        from sdnmpi_tpu.shardplane.mesh import init_multihost
+
+        init_multihost(*parse_distributed(args.distributed))
     config = config_from_args(args)
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
@@ -382,6 +409,29 @@ def build_parser() -> argparse.ArgumentParser:
         "hops row-shard over the mesh and every routing entry point "
         "partitions its flow batch across it, with packed per-host "
         "readback. Bit-identical routes; requires --mesh-devices N > 0",
+    )
+    parser.add_argument(
+        "--ring-exchange", dest="ring_exchange", action="store_true",
+        help="stream the sharded oracle's distance/next-hop exchange "
+        "through the double-buffered bidirectional ring (Pallas "
+        "make_async_remote_copy DMA on a real TPU mesh, the ppermute "
+        "twin elsewhere) with block-pipelined consumers, instead of "
+        "the blocking XLA all-gather. bf16/int16 wire, bit-identical "
+        "routes; requires --shard-oracle",
+    )
+    parser.add_argument(
+        "--no-ring-exchange", dest="ring_exchange", action="store_false",
+        help="keep the sharded legs on the XLA all-gather exchange "
+        "(the PR-9 default; byte-identical differential escape hatch)",
+    )
+    parser.set_defaults(ring_exchange=False)
+    parser.add_argument(
+        "--distributed", metavar="HOST:PORT,NPROC,RANK",
+        help="join a multi-host shardplane mesh: initialize "
+        "jax.distributed against the coordinator at HOST:PORT as "
+        "process RANK of NPROC, so every controller host's chips form "
+        "one global device set for --mesh-devices/--shard-oracle "
+        "(shardplane.mesh.init_multihost; NPROC=1 is a no-op)",
     )
     parser.add_argument(
         "--no-recovery", action="store_true",

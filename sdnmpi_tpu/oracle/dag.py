@@ -148,8 +148,16 @@ def restrict_dst(
     valid = (dst_nodes >= 0)[:, None]
     rows = jnp.maximum(dst_nodes, 0)
     dist_t = jnp.where(valid, dist.T[rows], INF)
-    traffic_t = jnp.where(valid, traffic[rows], 0.0)
-    return dist_t, traffic_t
+    return dist_t, restrict_dst_traffic(traffic, dst_nodes)
+
+
+def restrict_dst_traffic(traffic: jax.Array, dst_nodes: jax.Array) -> jax.Array:
+    """The traffic half of :func:`restrict_dst`, for callers whose
+    distance rows assemble elsewhere (the ring-exchange DAG leg builds
+    its [T/s, V] dist block inside the shard_map from arriving wire
+    blocks; traffic restriction stays a plain outer gather)."""
+    valid = (dst_nodes >= 0)[:, None]
+    return jnp.where(valid, traffic[jnp.maximum(dst_nodes, 0)], 0.0)
 
 
 def neighbor_table(

@@ -73,6 +73,34 @@ _m_shard_mesh = REGISTRY.gauge(
     "shard_mesh_devices",
     "devices of the oracle's shardplane mesh (0 = single-chip)",
 )
+# ring exchange (ISSUE 10): the distance/next-hop exchange leg.
+# shard_exchange_seconds records BLOCKING exchange walls — standalone
+# ring_all_gather materializations and the bench's measured legs; the
+# in-window/in-refresh exchanges are asynchronous program stages whose
+# attribution rides the shard_exchange child span instead (opened
+# under shard_dispatch with the wire-byte estimate, so a flight
+# bundle's span tree shows which dispatches carried an exchange).
+_m_shard_exchange_s = REGISTRY.histogram(
+    "shard_exchange_seconds",
+    help="blocking shardplane exchange wall seconds (ring or gather)",
+)
+_m_shard_overlap = REGISTRY.gauge(
+    "shard_exchange_overlap_gain",
+    "serial exchange+consume wall over the ring-overlapped wall "
+    "(config-10 overlap_gain idiom; >1 = exchange hidden behind "
+    "consumer compute; authoritative on the bench path)",
+)
+
+
+def note_exchange_overlap(serial_s: float, overlapped_s: float) -> float:
+    """Record the exchange-overlap gain: serial-equivalent wall (a
+    blocking exchange plus the consumer computing on pre-replicated
+    tensors) over the overlapped wall of the ring-streamed kernel.
+    Called by the bench twin (benchmarks/config13_shard.py) and tests;
+    returns the gain it set."""
+    gain = serial_s / max(overlapped_s, 1e-12)
+    _m_shard_overlap.set(gain)
+    return gain
 
 
 @jax.jit
@@ -342,6 +370,7 @@ class RouteOracle:
         max_diameter: int = 0,
         mesh_devices: int = 0,
         shard_oracle: bool = False,
+        ring_exchange: bool = False,
     ) -> None:
         if shard_oracle and not mesh_devices:
             import logging
@@ -379,6 +408,19 @@ class RouteOracle:
         #: mesh-sharded balanced/adaptive/collective legs. Only
         #: meaningful with mesh_devices > 0 (validated above).
         self.shard_oracle = shard_oracle and mesh_devices > 0
+        if ring_exchange and not self.shard_oracle:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ring_exchange needs shard_oracle; staying on the "
+                "gather path"
+            )
+        #: communication-overlapped exchange (ISSUE 10): the sharded
+        #: refresh/window legs stream the row-sharded tensors through
+        #: the bidirectional ring (kernels/ring.py) and consume blocks
+        #: as they arrive, instead of re-replicating through a
+        #: blocking XLA all-gather. Bit-identical routes (pinned).
+        self.ring_exchange = bool(ring_exchange) and self.shard_oracle
         self._mesh = None  # lazily-built jax.sharding.Mesh
         self._version: Optional[int] = None
         self._tensors: Optional[TopoTensors] = None
@@ -518,17 +560,34 @@ class RouteOracle:
                     # shardplane refresh (ISSUE 9): BFS sources AND
                     # next-hop rows block-shard over EVERY mesh device
                     # (the prototype's "v"-axis BFS used only that
-                    # sub-axis); occupied-column bucketing rides along
+                    # sub-axis); occupied-column bucketing rides along.
+                    # Under ring_exchange (ISSUE 10) the next-hop
+                    # argmin consumes the distance blocks straight off
+                    # the bidirectional ring — no blocking all-gather
+                    # on the refresh critical path, bf16 wire.
                     from sdnmpi_tpu.shardplane import (
                         apsp_distances_rowsharded,
+                        apsp_next_hops_ringed,
                         apsp_next_hops_rowsharded,
                     )
 
                     dist = apsp_distances_rowsharded(tensors.adj, mesh)
-                    nxt = apsp_next_hops_rowsharded(
-                        tensors.adj, dist, mesh, tensors.max_degree,
-                        n_occ=n_occ,
-                    )
+                    if self.ring_exchange:
+                        from sdnmpi_tpu.kernels.ring import dist_wire_dtype
+
+                        with self._shard_exchange_scope(
+                            tensors.v, tensors.v if n_occ == 0 else n_occ,
+                            jnp.dtype(dist_wire_dtype(tensors.v)).itemsize,
+                        ):
+                            nxt = apsp_next_hops_ringed(
+                                tensors.adj, dist, mesh,
+                                tensors.max_degree, n_occ=n_occ,
+                            )
+                    else:
+                        nxt = apsp_next_hops_rowsharded(
+                            tensors.adj, dist, mesh, tensors.max_degree,
+                            n_occ=n_occ,
+                        )
                 elif (
                     mesh is not None
                     and self.max_diameter == 0  # sharded BFS has no cap
@@ -1124,18 +1183,36 @@ class RouteOracle:
             pow2=_dirty is not None,
         )
         if shard_mesh is not None:
-            from sdnmpi_tpu.shardplane import batch_fdb_sharded
+            from sdnmpi_tpu.shardplane import (
+                batch_fdb_ringed,
+                batch_fdb_sharded,
+            )
 
             with self._shard_dispatch_scope(len(src_p)):
-                nodes_d, ports_d, length_d = batch_fdb_sharded(
-                    self._next_d,
-                    t.port,
-                    jnp.asarray(src_p),
-                    jnp.asarray(dst_p),
-                    jnp.asarray(fport_p),
-                    max_len,
-                    shard_mesh,
-                )
+                if self.ring_exchange:
+                    # ring-streamed chase (ISSUE 10): the next-hop
+                    # rows arrive over the ring (int16 wire; int32
+                    # past the index bound) while flows whose rows
+                    # already landed keep walking
+                    from sdnmpi_tpu.kernels.ring import NEXT_WIRE_MAX_V
+
+                    wire_item = 2 if t.v <= NEXT_WIRE_MAX_V else 4
+                    with self._shard_exchange_scope(t.v, t.v, wire_item):
+                        nodes_d, ports_d, length_d = batch_fdb_ringed(
+                            self._next_d, t.port,
+                            jnp.asarray(src_p), jnp.asarray(dst_p),
+                            jnp.asarray(fport_p), max_len, shard_mesh,
+                        )
+                else:
+                    nodes_d, ports_d, length_d = batch_fdb_sharded(
+                        self._next_d,
+                        t.port,
+                        jnp.asarray(src_p),
+                        jnp.asarray(dst_p),
+                        jnp.asarray(fport_p),
+                        max_len,
+                        shard_mesh,
+                    )
         else:
             nodes_d, ports_d, length_d = batch_fdb(
                 self._next_d,
@@ -1290,15 +1367,26 @@ class RouteOracle:
             # restriction only pays when T is actually smaller than V
             # (the pad floor is 128) and T divides the mesh
             use_dn = len(dn) < v_eff and len(dn) % self.mesh_devices == 0
-            with self._shard_dispatch_scope(len(src_p)):
-                slots_d, _maxc = route_collective_sharded(
-                    adj_eff, jnp.asarray(li), jnp.asarray(lj),
-                    jnp.asarray(util), jnp.asarray(traffic),
-                    jnp.asarray(src_p), jnp.asarray(dst_p),
-                    mesh, levels=max_len - 1, rounds=rounds,
-                    max_len=max_len, dist=dist_eff,
-                    dst_nodes=jnp.asarray(dn) if use_dn else None,
+            if self.ring_exchange:
+                from sdnmpi_tpu.kernels.ring import dist_wire_dtype
+
+                exch_scope = self._shard_exchange_scope(
+                    v_eff, v_eff,
+                    jnp.dtype(dist_wire_dtype(v_eff)).itemsize,
                 )
+            else:
+                exch_scope = contextlib.nullcontext()
+            with self._shard_dispatch_scope(len(src_p)):
+                with exch_scope:
+                    slots_d, _maxc = route_collective_sharded(
+                        adj_eff, jnp.asarray(li), jnp.asarray(lj),
+                        jnp.asarray(util), jnp.asarray(traffic),
+                        jnp.asarray(src_p), jnp.asarray(dst_p),
+                        mesh, levels=max_len - 1, rounds=rounds,
+                        max_len=max_len, dist=dist_eff,
+                        dst_nodes=jnp.asarray(dn) if use_dn else None,
+                        ring_exchange=self.ring_exchange,
+                    )
                 assert slots_d.shape[1] == sampled_hops(max_len)
                 _start_host_copy(slots_d)
 
@@ -1492,13 +1580,22 @@ class RouteOracle:
 
     def _dag_mesh(self):
         """The device mesh for the sharded DAG engine, or None when
-        single-device (device availability was settled in __init__)."""
+        single-device (device availability was settled in __init__).
+        Under a jax.distributed runtime (--distributed, ISSUE 10) the
+        mesh builds in canonical ring order over the GLOBAL device set
+        — every controller process derives the identical mesh from
+        (process_index, id) regardless of enumeration order, with each
+        host's shard contiguous on the exchange ring; single-process
+        keeps make_mesh (byte-compatible with the PR-9 layout)."""
         if not self.mesh_devices:
             return None
         if self._mesh is None:
-            from sdnmpi_tpu.shardplane import make_mesh
+            from sdnmpi_tpu.shardplane import make_mesh, make_multihost_mesh
 
-            self._mesh = make_mesh(self.mesh_devices)
+            if jax.process_count() > 1:
+                self._mesh = make_multihost_mesh(self.mesh_devices)
+            else:
+                self._mesh = make_mesh(self.mesh_devices)
             _m_shard_mesh.set(self.mesh_devices)
         return self._mesh
 
@@ -1532,6 +1629,35 @@ class RouteOracle:
             yield
         finally:
             _m_shard_dispatch_s.observe(time.perf_counter() - t0)
+            sp.end()
+
+    @contextlib.contextmanager
+    def _shard_exchange_scope(self, v_rows: int, n_cols: int,
+                              itemsize: int = 2):
+        """``shard_exchange`` child span around a ring-streamed leg
+        (ISSUE 10), nesting under the ambient span (``shard_dispatch``
+        for windows, the Router's ``route_window`` for the refresh) so
+        a flight-recorder bundle attributes a p99 spike to the
+        exchange leg and reads the wire bytes off the span. The span's
+        own duration is only the enqueue wall (the device-side
+        exchange is an asynchronous program stage; blocking exchange
+        walls land in ``shard_exchange_seconds``). ``itemsize`` is the
+        actual wire width — 2 for the packed bf16/int16 formats, 4
+        when a leg falls back to unpacked int32/f32."""
+        from sdnmpi_tpu.kernels.ring import exchange_bytes
+        from sdnmpi_tpu.utils.tracing import start_child_span
+
+        sp = start_child_span(
+            "shard_exchange",
+            exchange_bytes=exchange_bytes(
+                v_rows, n_cols, self.mesh_devices, itemsize
+            ),
+            mesh_devices=self.mesh_devices,
+            ring=True,
+        )
+        try:
+            yield
+        finally:
             sp.end()
 
     @staticmethod

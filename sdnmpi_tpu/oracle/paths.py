@@ -77,11 +77,23 @@ def batch_fdb(
 
     count_trace("batch_fdb")
     nodes, length = batch_paths(next_hop, src, dst, max_len)
+    return nodes, fdb_ports(port, nodes, length, final_port), length
+
+
+def fdb_ports(
+    port: jax.Array,
+    nodes: jax.Array,
+    length: jax.Array,
+    final_port: jax.Array,
+) -> jax.Array:
+    """Out-port rows for chased node rows — the port half of the fdb
+    layout, shared by :func:`batch_fdb` and the ring-streamed chase
+    (shardplane/routes.batch_fdb_ringed) so the two extractions cannot
+    drift in how the final host-facing port is spliced in."""
     f = nodes.shape[0]
     safe = jnp.maximum(nodes, 0)
     nxt = jnp.concatenate([safe[:, 1:], safe[:, -1:]], axis=1)
     ports = port[safe, nxt]
     last = jnp.maximum(length - 1, 0)
     ports = ports.at[jnp.arange(f), last].set(final_port)
-    ports = jnp.where(nodes >= 0, ports, -1)
-    return nodes, ports, length
+    return jnp.where(nodes >= 0, ports, -1)

@@ -18,15 +18,21 @@ untouched consumers.
 from sdnmpi_tpu.shardplane.apsp import (  # noqa: F401
     apsp_distances_rowsharded,
     apsp_distances_sharded,
+    apsp_next_hops_ringed,
     apsp_next_hops_rowsharded,
 )
 from sdnmpi_tpu.shardplane.mesh import (  # noqa: F401
+    device_ring_order,
     host_shard_devices,
+    init_multihost,
     make_mesh,
+    make_multihost_mesh,
     mesh_axes,
+    mesh_processes,
     mesh_shards,
 )
 from sdnmpi_tpu.shardplane.routes import (  # noqa: F401
+    batch_fdb_ringed,
     batch_fdb_sharded,
     multichip_route_step,
     route_adaptive_sharded,

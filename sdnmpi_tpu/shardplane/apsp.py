@@ -23,6 +23,13 @@ Both shard the same ops elementwise as their single-chip twins — the
 bit-identity fence in tests/test_shardplane.py pins it per generator
 topology. The legacy "v"-axis-only BFS (``apsp_distances_sharded``)
 stays for the mesh_devices-era refresh path, unchanged.
+
+``apsp_next_hops_ringed`` (ISSUE 10, ``Config.ring_exchange``) is the
+communication-overlapped form of the next-hop kernel: instead of the
+implicit blocking all-gather the replicated ``dist_full`` argument
+forces, destination-column slices of every shard's distance block ride
+the bidirectional ring (bf16 wire, kernels/ring.py) and the argmin
+consumes column block c while block c+1 is in flight.
 """
 
 from __future__ import annotations
@@ -119,13 +126,12 @@ def apsp_distances_rowsharded(adj: jax.Array, mesh) -> jax.Array:
     return _apsp_rowsharded_fn(mesh, v)(adj, jnp.eye(v, dtype=jnp.float32))
 
 
-def _flat_shard_index(mesh) -> jax.Array:
-    """Flattened device index inside a shard_map body: row-major over
-    the mesh's axes, matching how shard_map lays row blocks out."""
-    idx = jnp.int32(0)
-    for name in mesh.axis_names:
-        idx = idx * mesh.shape[name] + lax.axis_index(name)
-    return idx
+# row-major flattened device index — ONE implementation, shared with
+# the ring kernels whose logical addressing must match shard_map's
+# block layout exactly (kernels/ring.py owns it)
+from sdnmpi_tpu.kernels.ring import (  # noqa: E402
+    flat_shard_index as _flat_shard_index,
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -181,6 +187,115 @@ def _nexthop_rowsharded_fn(mesh, v: int, max_degree: int, n_cols: int):
         return jnp.where(rows[:, None] == cols[None, :], rows[:, None], nxt)
 
     return block_nexthops
+
+
+@functools.lru_cache(maxsize=None)
+def _nexthop_ringed_fn(mesh, v: int, max_degree: int, n_cols: int):
+    """Cached jitted ring-exchanged next-hop kernel (ISSUE 10): the
+    row-sharded distance matrix never re-replicates through a blocking
+    all-gather — destination-column slices of every shard's block ride
+    the bidirectional ring (bf16 wire, kernels/ring.py) and the
+    degree-compact argmin consumes column block c while block c+1's
+    slices are in flight (the ring steps for c+1 are independent of
+    c's argmin, so the scheduler overlaps them). Work is identical to
+    the gather-then-argmin kernel — same column blocking, same
+    candidate gathers — only the exchange moves, off the critical path
+    and at half the bytes."""
+    from sdnmpi_tpu.kernels.ring import (
+        pack_dist_wire,
+        ring_stream,
+        unpack_dist_wire,
+    )
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    axes = mesh_axes(mesh)
+    n_shards = mesh_shards(mesh)
+    rows_per = v // n_shards
+    d = min(max_degree, v)
+    block = _fit_block(n_cols, rows_per * d)
+    if block == n_cols and n_cols % 2 == 0 and n_cols >= 16:
+        # the software pipeline needs >= 2 column blocks to have a
+        # next transfer to hide behind the current argmin
+        block = n_cols // 2
+    n_blocks = n_cols // block
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axes, None),  # my rows' dist block — NEVER re-replicated
+            P(axes, None),  # my rows' neighbor-valid mask
+            P(axes, None),  # my rows' sorted-neighbor table
+        ),
+        out_specs=P(axes, None),
+        check_vma=False,  # outputs are genuinely row-sharded
+    )
+    def block_nexthops(dist_mine, valid_b, safe_b):
+        count_trace("shard_next_hops_ring")
+        row0 = _flat_shard_index(mesh) * rows_per
+        rows = row0 + jnp.arange(rows_per, dtype=jnp.int32)
+        cols = jnp.arange(v, dtype=jnp.int32)
+        # hop counts are bounded by the FULL matrix's V, not the slice
+        wire = pack_dist_wire(dist_mine[:, :n_cols], v)
+
+        def assemble(c):  # ring-gather column block c of every shard
+            def consume(buf, blk, src, _step):
+                return lax.dynamic_update_slice(
+                    buf, unpack_dist_wire(blk), (src * rows_per, 0)
+                )
+
+            return ring_stream(
+                mesh,
+                wire[:, c * block:(c + 1) * block],
+                consume,
+                jnp.zeros((v, block), jnp.float32),
+            )
+
+        # software pipeline: block c's argmin consumes the assembled
+        # columns while block c+1's ring transfers are in flight
+        buf = assemble(0)
+        cores = []
+        for c in range(1, n_blocks):
+            ahead = assemble(c)
+            cores.append(_degree_compact_block(valid_b, safe_b, buf))
+            buf = ahead
+        cores.append(_degree_compact_block(valid_b, safe_b, buf))
+        core = cores[0] if n_blocks == 1 else jnp.concatenate(cores, axis=1)
+        # identical tail to the rowsharded kernel: analytic padding
+        # columns, unreachable mask, diagonal self-hops
+        nxt = jnp.full((rows_per, v), 0, jnp.int32)
+        nxt = lax.dynamic_update_slice(nxt, core, (0, 0))
+        nxt = jnp.where(jnp.isinf(dist_mine), -1, nxt)
+        return jnp.where(rows[:, None] == cols[None, :], rows[:, None], nxt)
+
+    return block_nexthops
+
+
+def apsp_next_hops_ringed(
+    adj: jax.Array,
+    dist: jax.Array,
+    mesh,
+    max_degree: int,
+    n_occ: int = 0,
+) -> jax.Array:
+    """Ring-exchanged twin of :func:`apsp_next_hops_rowsharded` —
+    bit-identical output (same degree-compact argmin over the same
+    column blocks; the bf16 wire round-trips hop counts exactly,
+    kernels/ring.WIRE_EXACT_MAX_HOPS), with the distance exchange
+    streamed through the bidirectional ring instead of a blocking
+    XLA all-gather ahead of the compute. ``Config.ring_exchange``
+    selects it on the shardplane refresh path."""
+    from sdnmpi_tpu.oracle.dag import neighbor_table
+
+    v = adj.shape[0]
+    n_shards = mesh_shards(mesh)
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by {n_shards} mesh devices")
+    n_cols = v if n_occ <= 0 else min(v, n_occ)
+    _, valid, safe = neighbor_table(adj, max_degree)
+    fn = _nexthop_ringed_fn(mesh, v, max_degree, n_cols)
+    return fn(dist, valid, safe)
 
 
 def apsp_next_hops_rowsharded(

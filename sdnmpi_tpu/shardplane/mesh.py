@@ -39,6 +39,20 @@ except ImportError:  # 0.4.x: experimental home, check_vma spelled check_rep
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402,F401
 
 
+def _mesh_from_devices(devices) -> Mesh:
+    """The one ("flow", "v") mesh construction: with 4+ devices both
+    axes are non-trivial (n/2 x 2); fewer degenerate to (n, 1). Shared
+    by :func:`make_mesh` and :func:`make_multihost_mesh` so the axis
+    semantics every lru-cached shardplane builder keys on cannot
+    drift between the single- and multi-host paths."""
+    n = len(devices)
+    if n >= 4 and n % 2 == 0:
+        shape = (n // 2, 2)
+    else:
+        shape = (n, 1)
+    return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
+
+
 def make_mesh(n_devices: int) -> Mesh:
     """Mesh over the first n devices: axes ("flow", "v"). With 4+ devices
     both axes are non-trivial (n/2 x 2); fewer devices degenerate to
@@ -46,11 +60,7 @@ def make_mesh(n_devices: int) -> Mesh:
     devices = jax.devices()[:n_devices]
     if len(devices) < n_devices:
         raise ValueError(f"need {n_devices} devices, have {len(devices)}")
-    if n_devices >= 4 and n_devices % 2 == 0:
-        shape = (n_devices // 2, 2)
-    else:
-        shape = (n_devices, 1)
-    return Mesh(np.array(devices).reshape(shape), ("flow", "v"))
+    return _mesh_from_devices(devices)
 
 
 def mesh_axes(mesh: Mesh) -> tuple[str, ...]:
@@ -67,12 +77,97 @@ def mesh_shards(mesh: Mesh) -> int:
 
 
 def host_shard_devices(requested: int = 0) -> int:
-    """How many devices a shardplane mesh can span on this host.
+    """How many devices a shardplane mesh can span from this process.
 
     ``requested`` > 0 clamps to what exists; 0 asks for everything. The
     answer counts whatever ``jax.devices()`` exposes — real chips on a
-    slice, or the virtual CPU devices ``--xla_force_host_platform_
-    device_count`` created (the tier-1 dev loop; see tests/conftest.py).
+    slice, the virtual CPU devices ``--xla_force_host_platform_
+    device_count`` created (the tier-1 dev loop; see tests/conftest.py),
+    or, after :func:`init_multihost`, the GLOBAL device set across
+    every controller host (jax.devices() is global once
+    ``jax.distributed`` is initialized).
     """
     have = len(jax.devices())
     return min(requested, have) if requested > 0 else have
+
+
+# -- multi-host meshes (ISSUE 10) --------------------------------------
+
+
+def _distributed_initialized() -> bool:
+    """Whether jax.distributed is already up — probed WITHOUT touching
+    jax.process_count()/jax.devices(), which would initialize the
+    local backends and make a subsequent ``jax.distributed.
+    initialize()`` raise ('must be called before any JAX
+    computations')."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - private-API drift
+        return False
+
+
+def init_multihost(
+    coordinator: str, num_processes: int, process_id: int,
+) -> bool:
+    """Initialize ``jax.distributed`` so every controller host's chips
+    join one global device set (the precondition for a multi-host
+    shardplane mesh — and the concrete first step toward a second
+    controller instance owning a switch shard, the ROADMAP's
+    active/active door). Returns True when a multi-process runtime was
+    actually brought up; a single-process request is a no-op (the
+    local devices already form the mesh), and re-initialization is
+    idempotent. Must run before any jax computation (the launch path
+    calls it first thing in ``amain``)."""
+    if num_processes <= 1:
+        return False
+    if _distributed_initialized():  # idempotent
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def device_ring_order(devices) -> list:
+    """Devices in shardplane ring order: grouped by owning process
+    (host), ordered by (process_index, device id) within and across
+    groups. Two properties the exchange kernels rely on:
+
+    - **stable under enumeration order** — jax may hand back devices in
+      any order; sorting by the (process_index, id) pair always yields
+      the same ring, so every process builds the identical mesh (a
+      requirement for multi-controller ``shard_map``).
+    - **hosts contiguous on the ring** — each host's chips occupy one
+      contiguous arc, so of the 2(s-1) directed ring hops a
+      bidirectional exchange makes, only 2·(n_hosts-1)ish cross the
+      DCN; the rest stay on local ICI. Duck-typed (anything with
+      ``process_index`` and ``id``), so the 2-host facts are testable
+      on a single-host dev box (tests/test_ring.py).
+    """
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def make_multihost_mesh(n_devices: int = 0, devices=None) -> Mesh:
+    """Mesh over the global (cross-host) device set in ring order.
+
+    ``devices`` defaults to ``jax.devices()`` — local chips in a
+    single-process run, every host's chips after :func:`init_multihost`.
+    ``n_devices`` > 0 takes the first N of the ring order (0 = all).
+    The mesh axes match :func:`make_mesh` (("flow", "v"), n/2 x 2 when
+    even), so every shardplane kernel — including the ring exchange,
+    whose logical neighbor addressing follows exactly this device
+    order — runs unchanged on it."""
+    devs = device_ring_order(jax.devices() if devices is None else devices)
+    if n_devices > 0:
+        devs = devs[:n_devices]
+    return _mesh_from_devices(devs)
+
+
+def mesh_processes(mesh: Mesh) -> int:
+    """How many controller hosts (jax processes) the mesh spans — 1 on
+    a single-host slice or the virtual CPU mesh."""
+    return len({d.process_index for d in mesh.devices.flat})

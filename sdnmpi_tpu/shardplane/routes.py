@@ -13,6 +13,13 @@ count, not fabric capacity (asserted by tests/test_shardplane.py).
 from parallel/mesh.py; ``batch_fdb_sharded`` is the shardplane twin of
 oracle/paths.batch_fdb (the shortest-path window extraction), added so
 `Config.shard_oracle` can run EVERY routing entry point on the mesh.
+Under ``Config.ring_exchange`` (ISSUE 10) the replication of the
+row-sharded next-hop/distance tensors moves off the blocking XLA
+all-gather onto the bidirectional ring (kernels/ring.py):
+``batch_fdb_ringed`` chases hops as the rows arrive, and
+``route_collective_sharded(ring_exchange=True)`` assembles distances
+in-program behind its dist-independent prep — bit-identical rows
+either way.
 All of them are dispatch-only from the engine's ``*_dispatch`` twins:
 JAX async dispatch enqueues the multi-device program and the window's
 ``reap()`` blocks only on its own transfer, so sharded windows ride the
@@ -91,6 +98,135 @@ def batch_fdb_sharded(
             f"flow count {src.shape[0]} must divide by {n_shards} shards"
         )
     return _batch_fdb_fn(mesh, max_len)(next_hop, port, src, dst, final_port)
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_fdb_ringed_fn(mesh, max_len: int, v: int):
+    """Cached ring-exchanged fdb extraction (ISSUE 10): the row-sharded
+    next-hop matrix streams around the bidirectional ring as int16 wire
+    blocks (exact while V < 2**15) instead of re-replicating through a
+    blocking all-gather, and each device's per-flow hop chases advance
+    opportunistically as the rows they need arrive — a flow whose next
+    row landed with an earlier block walks on while later blocks are
+    still in flight; a bounded completion pass after the last arrival
+    finishes whatever chased into a not-yet-arrived row. Node/port
+    rows come out bit-identical to ``batch_fdb`` (the chase is
+    deterministic; arrival order only changes WHEN a hop happens, not
+    what it reads)."""
+    from sdnmpi_tpu.kernels.ring import (
+        NEXT_WIRE_MAX_V,
+        pack_next_wire,
+        ring_stream,
+        unpack_next_wire,
+    )
+    from sdnmpi_tpu.oracle.paths import fdb_ports
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    axes = mesh_axes(mesh)
+    n_shards = mesh_shards(mesh)
+    rows_per = v // n_shards
+    wire16 = v <= NEXT_WIRE_MAX_V
+    # opportunistic hops per arrival; the completion pass has the full
+    # budget, so a flow stalled on a late block still finishes
+    h_opp = max(1, -(-max_len // n_shards))
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axes, None),  # my rows of the next-hop matrix — no gather
+            P(None, None),  # port matrix (replicated from tensorize)
+            P(axes),  # src slice
+            P(axes),  # dst slice
+            P(axes),  # final-port slice
+        ),
+        out_specs=(P(axes, None), P(axes, None), P(axes)),
+        check_vma=False,  # outputs are genuinely flow-sharded
+    )
+    def inner(next_mine, port, s, t, fp):
+        count_trace("shard_batch_fdb_ring")
+        f = s.shape[0]
+        rows_i = jnp.arange(f)
+        wire = pack_next_wire(next_mine) if wire16 else next_mine
+
+        def hop(state):
+            # one masked chase iteration, the exact batch_paths step:
+            # emit the current node, move to next_hop[node, dst] —
+            # gated on the node's row block having arrived
+            buf, arrived, node, k, out = state
+            at_dst = node == t
+            safe = jnp.maximum(node, 0)
+            avail = arrived[jnp.clip(safe // rows_per, 0, n_shards - 1)]
+            can = (node >= 0) & (k < max_len) & (avail | at_dst)
+            nxt = buf[safe, jnp.maximum(t, 0)]
+            nxt = jnp.where(at_dst | (t < 0), -1, nxt)
+            kcl = jnp.minimum(k, max_len - 1)
+            out = out.at[rows_i, kcl].set(
+                jnp.where(can, node, out[rows_i, kcl])
+            )
+            k = k + can.astype(jnp.int32)
+            node = jnp.where(can, nxt, node)
+            return buf, arrived, node, k, out
+
+        def consume(state, blk, src, _step):
+            buf, arrived, node, k, out = state
+            buf = lax.dynamic_update_slice(
+                buf, unpack_next_wire(blk) if wire16 else blk,
+                (src * rows_per, 0),
+            )
+            arrived = arrived.at[src].set(True)
+            return lax.fori_loop(
+                0, h_opp, lambda _, st: hop(st),
+                (buf, arrived, node, k, out),
+            )
+
+        state = (
+            jnp.zeros((v, v), jnp.int32),
+            jnp.zeros((n_shards,), bool),
+            s,
+            jnp.zeros(f, jnp.int32),
+            jnp.full((f, max_len), -1, jnp.int32),
+        )
+        state = ring_stream(mesh, wire, consume, state)
+        _, _, _, _, out = lax.fori_loop(
+            0, max_len, lambda _, st: hop(st), state
+        )
+        # batch_paths' validity tail: a flow counts only if it reached
+        length = jnp.sum(out >= 0, axis=1)
+        reached = jnp.where(
+            length > 0, out[rows_i, jnp.maximum(length - 1, 0)] == t, False
+        )
+        nodes = jnp.where(reached[:, None], out, -1)
+        length = jnp.where(reached, length, 0)
+        return nodes, fdb_ports(port, nodes, length, fp), length
+
+    return inner
+
+
+def batch_fdb_ringed(
+    next_hop: jax.Array,
+    port: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    final_port: jax.Array,
+    max_len: int,
+    mesh,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Ring-exchange twin of :func:`batch_fdb_sharded`, selected by
+    ``Config.ring_exchange``: same contract and bit-identical rows,
+    with the next-hop matrix streamed over the ring while the hop
+    chases consume it (see ``_batch_fdb_ringed_fn``)."""
+    n_shards = mesh_shards(mesh)
+    if src.shape[0] % n_shards:
+        raise ValueError(
+            f"flow count {src.shape[0]} must divide by {n_shards} shards"
+        )
+    v = next_hop.shape[0]
+    if v % n_shards:
+        raise ValueError(f"V={v} must divide by {n_shards} shards")
+    fn = _batch_fdb_ringed_fn(mesh, max_len, v)
+    return fn(next_hop, port, src, dst, final_port)
 
 
 def window_readback_nbytes(wr) -> int:
@@ -298,6 +434,7 @@ def route_collective_sharded(
     salt: int = 0,
     dist: jax.Array | None = None,  # cached APSP distances, else computed
     dst_nodes: jax.Array | None = None,  # [T] int32 destination set (-1 pad)
+    ring_exchange: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """The flagship MXU DAG engine (oracle/dag.route_collective) sharded
     over every device of the mesh ("flow" x "v" axes flattened).
@@ -357,7 +494,10 @@ def route_collective_sharded(
     dst_arg = (
         dst_nodes if have_dst else jnp.zeros((n_shards,), dtype=jnp.int32)
     )
-    step = _dag_step(mesh, levels, rounds, max_len, salt, have_dist, have_dst)
+    step = _dag_step(
+        mesh, levels, rounds, max_len, salt, have_dist, have_dst,
+        bool(ring_exchange),
+    )
     return step(
         adj, link_src, link_dst, link_util, traffic, src, dst, dist_arg,
         dst_arg,
@@ -367,7 +507,7 @@ def route_collective_sharded(
 @functools.lru_cache(maxsize=None)
 def _dag_step(
     mesh, levels: int, rounds: int, max_len: int, salt: int,
-    have_dist: bool, have_dst: bool = False,
+    have_dist: bool, have_dst: bool = False, ring_exchange: bool = False,
 ):
     """Build (and cache) the jitted sharded DAG step for one config.
 
@@ -385,6 +525,11 @@ def _dag_step(
     )
 
     hops = sampled_hops(max_len)
+
+    if ring_exchange:
+        return _dag_step_ringed(
+            mesh, levels, rounds, hops, salt, have_dist, have_dst,
+        )
 
     @jax.jit
     def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in,
@@ -447,6 +592,121 @@ def _dag_step(
             return slots, maxc[None, None]
 
         slots, maxc = inner(adj, d, d_t, base, traffic, src, dst, dst_nodes)
+        return slots, maxc[0, 0]
+
+    return step
+
+
+def _dag_step_ringed(
+    mesh, levels: int, rounds: int, hops: int, salt: int,
+    have_dist: bool, have_dst: bool,
+):
+    """The ring-exchange form of the sharded DAG step (ISSUE 10): the
+    distance matrix enters ROW-SHARDED (``P(axes, None)`` — no implicit
+    all-gather at program entry) and assembles inside the shard_map
+    from bf16 wire blocks riding the bidirectional ring, while the
+    dist-independent prep (utilization scatter, adjacency cast, the
+    first congestion reweighting) runs with nothing to wait on — the
+    exchange hides behind the compute it feeds. Everything downstream
+    of the assembled matrix (level propagation, psum-ed balance
+    rounds, the fused sampler) is the exact op sequence of the
+    gather-mode step, so slots and congestion come out bit-identical
+    on the bf16-exact hop-count domain (tests/test_shardplane.py)."""
+    from sdnmpi_tpu.kernels.ring import (
+        flat_shard_index,
+        pack_dist_wire,
+        ring_stream,
+        unpack_dist_wire,
+    )
+    from sdnmpi_tpu.oracle.dag import (
+        congestion_weights,
+        propagate_levels,
+        restrict_dst_traffic,
+        sample_paths_dense,
+    )
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    axes = mesh_axes(mesh)
+    n_shards = mesh_shards(mesh)
+
+    @jax.jit
+    def step(adj, link_src, link_dst, link_util, traffic, src, dst, dist_in,
+             dst_nodes):
+        v = adj.shape[0]
+        rows_per = v // n_shards
+        base = (
+            jnp.zeros((v, v), jnp.float32)
+            .at[link_src, link_dst]
+            .set(link_util, unique_indices=True, mode="drop")
+        )
+        d_sh = dist_in if have_dist else apsp_distances_sharded(adj, mesh)
+        if have_dst:
+            # the traffic half of restrict_dst; the dist half assembles
+            # from the ring inside the shard_map body
+            traffic = restrict_dst_traffic(traffic, dst_nodes)
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(None, None),  # adj
+                P(axes, None),  # dist rows — stay sharded, ring inside
+                P(None, None),  # base cost
+                P(axes, None),  # traffic T block
+                P(axes),  # src slice
+                P(axes),  # dst slice
+                P(None),  # dst set (replicated: samplers match on it)
+            ),
+            out_specs=(P(axes, None), P(None, None)),
+            check_vma=False,  # psum-derived outputs are replicated
+        )
+        def inner(a, d_local, base, traffic_local, s, t, dn):
+            count_trace("shard_dag_ring")
+            adj_f = (a > 0).astype(jnp.float32)
+            # dist-independent prep first: the ring's transfers overlap it
+            weights = congestion_weights(adj_f, base)
+
+            def consume(buf, blk, srcq, _step):
+                return lax.dynamic_update_slice(
+                    buf, unpack_dist_wire(blk), (srcq * rows_per, 0)
+                )
+
+            d_full = ring_stream(
+                mesh, pack_dist_wire(d_local, v), consume,
+                jnp.zeros((v, v), jnp.float32),
+            )
+            shard_idx = flat_shard_index(mesh)
+            if have_dst:
+                t_per = dn.shape[0] // n_shards
+                dn_loc = lax.dynamic_slice(dn, (shard_idx * t_per,), (t_per,))
+                valid = (dn_loc >= 0)[:, None]
+                d_t_local = jnp.where(
+                    valid, d_full.T[jnp.maximum(dn_loc, 0)], INF
+                )
+            else:
+                d_t_local = lax.dynamic_slice(
+                    jnp.swapaxes(d_full, 0, 1),
+                    (shard_idx * rows_per, 0), (rows_per, v),
+                )
+            load = lax.psum(
+                propagate_levels(weights, d_t_local, traffic_local, levels),
+                ("flow", "v"),
+            )
+            for _ in range(rounds - 1):
+                weights = congestion_weights(adj_f, base + load)
+                load = lax.psum(
+                    propagate_levels(weights, d_t_local, traffic_local, levels),
+                    ("flow", "v"),
+                )
+            maxc = jnp.max(load)
+            fid_base = (shard_idx * s.shape[0]).astype(jnp.uint32)
+            _, slots = sample_paths_dense(
+                weights, d_full, s, t, hops, salt=salt, fid_base=fid_base,
+                dst_nodes=dn if have_dst else None,
+            )
+            return slots, maxc[None, None]
+
+        slots, maxc = inner(adj, d_sh, base, traffic, src, dst, dst_nodes)
         return slots, maxc[0, 0]
 
     return step

@@ -301,6 +301,25 @@ class TestRecoveryFlags:
         assert cfg.echo_interval_s == 3.0 and cfg.echo_timeout_s == 9.0
         assert args.chaos == 42
 
+    def test_ring_exchange_flags_map_to_config(self):
+        """--ring-exchange / --no-ring-exchange wire Config.ring_exchange
+        (default off — the PR-9 gather path); the last flag wins."""
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.ring_exchange is False
+        cfg = launch.config_from_args(_parse([
+            "--mesh-devices", "8", "--shard-oracle", "--ring-exchange",
+        ]))
+        assert cfg.ring_exchange is True and cfg.shard_oracle
+        cfg = launch.config_from_args(_parse([
+            "--ring-exchange", "--no-ring-exchange",
+        ]))
+        assert cfg.ring_exchange is False
+        # --distributed parses beside them (no runtime init in tests)
+        args = _parse(["--distributed", "10.0.0.2:8476,2,1"])
+        assert launch.parse_distributed(args.distributed) == (
+            "10.0.0.2:8476", 2, 1
+        )
+
     def test_schedule_phases_flag_maps_to_config(self):
         """--schedule-phases arms the collective phase scheduler; omitted
         it stays off (the bit-identical single-shot default)."""

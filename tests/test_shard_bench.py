@@ -10,6 +10,7 @@ occupancy slicing) fails CI here before it can burn a TPU suite.
 """
 
 import numpy as np
+import pytest
 
 from benchmarks.config13_shard import build, occ_args, validate
 from tests.conftest import N_VIRTUAL_DEVICES
@@ -73,6 +74,60 @@ def test_padding_tax_twin_bucketed_matches_padded():
     slots_occ, _ = unpack_result(np.asarray(buf_occ), len(usrc), kw["max_len"])
     np.testing.assert_array_equal(slots_occ, slots_pad)
     validate(t, usrc, udst, slots_occ)
+
+
+def test_ring_twin_measures_and_fences(virtual_mesh):
+    """The ring_exchange twin's machinery at test scale (fattree k=4):
+    the helper fences ring == gather bit-identically before reporting
+    (a silently-wrong exchange raises), produces every column the
+    bench row carries, and records the overlap gauge."""
+    from benchmarks.config13_shard import measure_ring_exchange
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.topogen import fattree
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    db = fattree(4).to_topology_db(backend="jax", pad_multiple=8)
+    t = tensorize(db, 8)
+    m = measure_ring_exchange(t.adj, t.max_degree, virtual_mesh,
+                              warmup=1, iters=2)
+    for key in ("gather_ms", "ring_ms", "exchange_ms", "ring_exchange_ms",
+                "consume_ms", "overlap_gain", "exchange_bytes"):
+        assert key in m and m[key] >= 0
+    assert m["mesh_devices"] == N_VIRTUAL_DEVICES
+    v = t.adj.shape[0]
+    assert m["exchange_bytes"] == 7 * (v // 8) * v * 2  # bf16 wire
+    gauge = REGISTRY.get("shard_exchange_overlap_gain")
+    assert gauge.value == pytest.approx(m["overlap_gain"])
+    assert REGISTRY.histogram("shard_exchange_seconds").count > 0
+
+
+def test_config13_ring_row_passes_the_committed_regression_gate():
+    """The committed suite carries the ring twin row (config 13c) with
+    the acceptance pin — overlap gain > 1 recorded on the bench path —
+    and the regression gate passes a matching fresh row while failing
+    a degraded one (the CI fence without a TPU)."""
+    import json
+    import pathlib
+
+    from benchmarks import run as bench_run
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    baseline = json.loads((root / "BENCH_suite.json").read_text())
+    ring_rows = [
+        r for r in baseline
+        if r.get("config") == "13c"
+        and r.get("metric") == "fattree4096_ring_refresh_ms"
+    ]
+    assert ring_rows, "the ring twin row must be committed"
+    committed = ring_rows[0]
+    assert committed["vs_baseline"] > 1.0  # ring beats the gather leg
+    assert committed["overlap_gain"] > 1.0  # the acceptance pin
+    assert committed["exchange_bytes"] > 0
+    assert bench_run.check_rows(ring_rows) == []
+    fresh = [dict(committed)]
+    assert bench_run.check_regression(fresh, baseline) == []
+    bad = [dict(committed, vs_baseline=committed["vs_baseline"] * 0.5)]
+    assert bench_run.check_regression(bad, baseline)
 
 
 def test_config13_registered_and_schema_checked():

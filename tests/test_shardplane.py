@@ -33,11 +33,12 @@ TOPOS = {
 }
 
 
-def _db(spec, shard: bool):
+def _db(spec, shard: bool, ring: bool = False):
     db = spec.to_topology_db(backend="jax", pad_multiple=8)
     if shard:
         db.mesh_devices = N_VIRTUAL_DEVICES
         db.shard_oracle = True
+        db.ring_exchange = ring
     return db
 
 
@@ -233,6 +234,222 @@ def test_shard_oracle_default_off_is_single_chip():
     assert oracle.shard_oracle is False and oracle._shard_mesh() is None
     # shard_oracle without a mesh is refused, not half-engaged
     assert RouteOracle(shard_oracle=True).shard_oracle is False
+
+
+# -- ring exchange (ISSUE 10) ------------------------------------------
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_ring_distance_exchange_bit_identical(topo, virtual_mesh):
+    """The distance exchange itself, per generator topology: the
+    row-sharded BFS blocks re-replicated through the Pallas ring
+    kernel (interpret mode — the real kernel logic) and through the
+    ppermute twin both equal the sharded matrix bit-exactly, bf16
+    wire included."""
+    from sdnmpi_tpu.kernels.ring import exchange_distances
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.shardplane import apsp_distances_rowsharded
+
+    spec = TOPOS[topo]()
+    db = spec.to_topology_db(backend="jax", pad_multiple=8)
+    t = tensorize(db, 8)
+    d_sh = apsp_distances_rowsharded(t.adj, virtual_mesh)
+    ref = np.asarray(d_sh)
+    for interpret in (False, True):
+        got = np.asarray(
+            exchange_distances(d_sh, virtual_mesh, interpret=interpret)
+        )
+        np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_ringed_next_hops_bit_identical(topo, virtual_mesh):
+    """apsp_next_hops_ringed (block-pipelined ring consumption, bf16
+    wire) == apsp_next_hops_rowsharded (blocking gather) == the
+    single-chip kernel, per generator topology."""
+    from sdnmpi_tpu.oracle.apsp import apsp_distances, apsp_next_hops
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.shardplane import (
+        apsp_distances_rowsharded,
+        apsp_next_hops_ringed,
+        apsp_next_hops_rowsharded,
+    )
+
+    spec = TOPOS[topo]()
+    db = spec.to_topology_db(backend="jax", pad_multiple=8)
+    t = tensorize(db, 8)
+    d_single = apsp_distances(t.adj)
+    n_single = apsp_next_hops(t.adj, d_single, max_degree=t.max_degree)
+    d_sh = apsp_distances_rowsharded(t.adj, virtual_mesh)
+    n_gather = apsp_next_hops_rowsharded(
+        t.adj, d_sh, virtual_mesh, t.max_degree
+    )
+    n_ring = apsp_next_hops_ringed(t.adj, d_sh, virtual_mesh, t.max_degree)
+    np.testing.assert_array_equal(np.asarray(n_ring), np.asarray(n_gather))
+    np.testing.assert_array_equal(np.asarray(n_ring), np.asarray(n_single))
+
+
+def test_ringed_next_hops_occupancy_bit_identical(virtual_mesh):
+    """The occupied-column bucket rides the ring wire too: only the
+    occupied columns cross the fabric, and the analytic padding block
+    matches the full computation."""
+    import math
+
+    from sdnmpi_tpu.oracle.apsp import occ_bucket
+    from sdnmpi_tpu.oracle.engine import tensorize
+    from sdnmpi_tpu.shardplane import (
+        apsp_distances_rowsharded,
+        apsp_next_hops_ringed,
+        apsp_next_hops_rowsharded,
+    )
+
+    db = fattree(4).to_topology_db(backend="jax", pad_multiple=64)
+    t = tensorize(db, 64)
+    v = t.adj.shape[0]
+    b = occ_bucket(t.n_real, v, math.lcm(8, N_VIRTUAL_DEVICES))
+    assert t.n_real <= b < v
+    d_sh = apsp_distances_rowsharded(t.adj, virtual_mesh)
+    n_gather = apsp_next_hops_rowsharded(
+        t.adj, d_sh, virtual_mesh, t.max_degree, n_occ=b
+    )
+    n_ring = apsp_next_hops_ringed(
+        t.adj, d_sh, virtual_mesh, t.max_degree, n_occ=b
+    )
+    np.testing.assert_array_equal(np.asarray(n_ring), np.asarray(n_gather))
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_ring_shortest_batch_bit_identical(topo, virtual_mesh):
+    """find_routes_batch through the ring-streamed chase
+    (batch_fdb_ringed) == the gather-mode shardplane == single-chip."""
+    spec = TOPOS[topo]()
+    results = {}
+    for mode in ("single", "shard", "ring"):
+        db = _db(spec, mode != "single", ring=mode == "ring")
+        db._jax_oracle().host_chase_hop_budget = 0  # device leg, always
+        results[mode] = db.find_routes_batch(_pairs(db))
+    assert results["ring"] == results["shard"] == results["single"]
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_ring_balanced_batch_bit_identical(topo, virtual_mesh):
+    """find_routes_batch_balanced through the ring-mode DAG step (the
+    in-program distance assembly) == gather mode == single-chip."""
+    spec = TOPOS[topo]()
+    results = {}
+    for mode in ("single", "shard", "ring"):
+        db = _db(spec, mode != "single", ring=mode == "ring")
+        results[mode] = db.find_routes_batch_balanced(
+            _pairs(db), dag_threshold=1, ecmp_ways=2
+        )
+    assert results["ring"][0] == results["shard"][0] == results["single"][0]
+    assert abs(results["ring"][1] - results["single"][1]) < 1e-5
+
+
+@pytest.mark.parametrize("wire", [False, True])
+def test_ring_controller_bit_identical(wire, virtual_mesh):
+    """Config.ring_exchange at the controller level, sim + wire: a
+    block-installed alltoall with the ring exchange on rides the same
+    switches/links and delivers on the data plane, bit-identical to
+    the default-off controller — the ISSUE-10 default-off pin."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.announcement import Announcement, AnnouncementType
+    from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+    assert Config().ring_exchange is False  # the default-off pin
+    installs = {}
+    for ring in (False, True):
+        spec = fattree(4)
+        fabric = spec.to_fabric(wire=wire)
+        config = Config(
+            block_install_threshold=1,
+            mesh_devices=N_VIRTUAL_DEVICES,
+            shard_oracle=True,
+            ring_exchange=ring,
+        )
+        controller = Controller(fabric, config)
+        controller.attach()
+        macs = sorted(fabric.hosts)[:8]
+        for rank, mac in enumerate(macs):
+            fabric.hosts[mac].send(of.Packet(
+                eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff",
+                eth_type=of.ETH_TYPE_IP, ip_proto=of.IPPROTO_UDP,
+                udp_dst=config.announcement_port,
+                payload=Announcement(AnnouncementType.LAUNCH, rank).encode(),
+            ))
+        fabric.hosts[macs[0]].send(of.Packet(
+            eth_src=macs[0],
+            eth_dst=VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode(),
+            eth_type=of.ETH_TYPE_IP,
+        ))
+        table = controller.router.collectives
+        assert len(table) == 1
+        install = next(iter(table))
+        before = len(fabric.hosts[macs[2]].received)
+        fabric.hosts[macs[1]].send(of.Packet(
+            eth_src=macs[1],
+            eth_dst=VirtualMac(CollectiveType.ALLTOALL, 1, 2).encode(),
+            eth_type=of.ETH_TYPE_IP,
+        ))
+        assert len(fabric.hosts[macs[2]].received) > before
+        installs[ring] = install
+    a, b = installs[False], installs[True]
+    assert a.n_pairs == b.n_pairs and a.n_flows == b.n_flows
+    assert a.switches == b.switches
+    assert a.links == b.links
+
+
+def test_ring_exchange_needs_shard_oracle():
+    """ring_exchange without the shardplane is refused, not
+    half-engaged — mirrors the shard_oracle-without-mesh rule."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.oracle.engine import RouteOracle
+
+    assert Config().ring_exchange is False
+    oracle = RouteOracle(ring_exchange=True)
+    assert oracle.ring_exchange is False
+    oracle = RouteOracle(
+        mesh_devices=N_VIRTUAL_DEVICES, shard_oracle=True,
+        ring_exchange=True,
+    )
+    assert oracle.ring_exchange is True
+
+
+def test_ring_exchange_span_and_trace_counts(virtual_mesh):
+    """A ringed window dispatch opens a shard_exchange child span under
+    shard_dispatch (flight-recorder attribution, with the wire-byte
+    estimate), and repeating the window adds ZERO ring-kernel traces."""
+    from sdnmpi_tpu.utils import tracing
+    from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+    records = []
+    tracing.add_trace_sink(records.append)
+    try:
+        db = _db(fattree(4), True, ring=True)
+        db._jax_oracle().host_chase_hop_budget = 0
+        parent = tracing.start_span("route_window", n_pairs=1)
+        db.find_routes_batch_dispatch(_pairs(db)).reap()
+        parent.end()
+        warm = TRACE_COUNTS["shard_batch_fdb_ring"]
+        assert warm > 0
+        db.find_routes_batch_dispatch(_pairs(db)).reap()
+        assert TRACE_COUNTS["shard_batch_fdb_ring"] == warm
+        spans = [r for r in records if r.get("kind") == "span"]
+        exch = [r for r in spans if r["name"] == "shard_exchange"]
+        disp = [r for r in spans if r["name"] == "shard_dispatch"]
+        root = [r for r in spans if r["name"] == "route_window"]
+        assert exch and disp and root
+        # the refresh's exchange nests under the ambient route_window;
+        # the window's exchange nests under its shard_dispatch
+        parents = {r["parent"] for r in exch}
+        assert root[0]["span"] in parents
+        assert parents & {r["span"] for r in disp}
+        assert all(r["exchange_bytes"] > 0 and r["ring"] is True
+                   for r in exch)
+    finally:
+        tracing.remove_trace_sink(records.append)
 
 
 # -- occupancy-bucketed block kernels ----------------------------------
