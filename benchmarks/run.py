@@ -38,6 +38,7 @@ CONFIGS = [
     ("11", [sys.executable, "-m", "benchmarks.config11_recovery"]),
     ("12", [sys.executable, "-m", "benchmarks.config12_schedule"]),
     ("13", [sys.executable, "-m", "benchmarks.config13_shard"]),
+    ("14", [sys.executable, "-m", "benchmarks.config14_serving"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

@@ -208,6 +208,45 @@ class Config:
     #: switch instead of one per hop. False restores the serial
     #: resolve-then-install loop (the differential-testing path).
     pipelined_install: bool = True
+    # --- serving plane (ISSUE 11) ----------------------------------------
+    #: memoized route cache in front of the oracle
+    #: (oracle/routecache.py): completed route windows and collective
+    #: results keyed by (policy, UtilPlane epoch, pair-set digest) and
+    #: invalidated through the TopologyDB delta log — a link flap
+    #: evicts only entries whose stored routes rode the deleted link;
+    #: adds and membership changes clear. A hit bypasses the oracle
+    #: dispatch entirely and feeds the install plane the stored window,
+    #: bit-identical to a miss by construction. False restores the
+    #: PR-10 dispatch path byte-identically (the differential escape
+    #: hatch, pinned by tests/test_routecache.py).
+    route_cache: bool = True
+    #: LRU capacity of the route cache (entries; evictions counted in
+    #: route_cache_evictions_total)
+    route_cache_max_entries: int = 4096
+    #: per-tenant admission rate for packet-ins, requests/second
+    #: (control/admission.py): each tenant (source MACs grouped by
+    #: Router.admission.assign; ungrouped MACs tenant as themselves)
+    #: refills one token bucket at this rate and requests past it drop
+    #: at the door, so one tenant's alltoall storm cannot grow the
+    #: route queue without bound for everyone else. 0 (default) admits
+    #: everything — the pre-serving-plane behavior.
+    admission_rate: float = 0.0
+    #: token-bucket burst depth of the admission gate (requests a
+    #: quiet tenant may fire back-to-back before rate limiting bites)
+    admission_burst: float = 32.0
+    #: persistent JAX compilation cache directory ("" = off): compiled
+    #: device programs (APSP, window extraction, the DAG engine) are
+    #: written to disk and reloaded by a restarted controller, so the
+    #: 18-22 s cold trace+compile every BENCH_r0* log pays happens once
+    #: per fleet, not once per process (jax_compilation_cache_dir)
+    compile_cache_dir: str = ""
+    #: run RouteOracle.warm_serving at launch: compile the serving
+    #: path's kernels (APSP refresh + the window-extraction buckets)
+    #: against the booted topology BEFORE the first request arrives,
+    #: so a restarted controller serves its first route in seconds
+    #: (with compile_cache_dir, from the disk cache)
+    warm_serving: bool = False
+
     #: backpressure cap for batched FlowMod sends: a per-switch burst is
     #: written to the wire in slices of at most this many bytes, with
     #: the stalled-peer write-buffer check re-run between slices — one

@@ -235,6 +235,12 @@ class _PendingRoute:
     #: monotonic park time — each flushed window's age histogram sample
     #: is measured from ITS oldest member, not the queue's first park
     t_parked: float = 0.0
+    #: coalescer class (ISSUE 11): True for collective-member MPI
+    #: lookups (an alltoall storm's per-pair packet-ins), False for
+    #: latency-sensitive traffic (plain unicast, MPI point-to-point).
+    #: Window composition takes latency-sensitive entries first, so a
+    #: bulk storm parks BEHIND the pairs users are waiting on.
+    bulk: bool = False
 
 
 class Router:
@@ -280,6 +286,14 @@ class Router:
         #: retry / anti-entropy behaviors gate on Config.recovery_plane.
         self.recovery = RecoveryPlane(config)
         self.recovery.on_exhausted = self._resync_datapath
+        #: per-tenant admission gate (ISSUE 11, control/admission.py):
+        #: every packet-in passes through it BEFORE any routing work.
+        #: Config.admission_rate == 0 (the default) admits everything.
+        from sdnmpi_tpu.control.admission import AdmissionControl
+
+        self.admission = AdmissionControl(
+            config.admission_rate, config.admission_burst
+        )
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -501,6 +515,9 @@ class Router:
         if is_sdn_mpi_addr(dst):
             return self._mpi_packet_in(event)
 
+        if not self.admission.admit(src):
+            return  # over the tenant's admitted rate: drop at the door
+
         log.info("Packet in at %s (%s) %s -> %s", event.dpid, event.in_port, src, dst)
 
         _m_packet_ins.inc()
@@ -524,6 +541,12 @@ class Router:
 
     def _mpi_packet_in(self, event: ev.EventPacketIn) -> None:
         pkt = event.pkt
+        if not self.admission.admit(pkt.eth_src):
+            # over the tenant's admitted rate: drop at the door — before
+            # the vMAC decode, the per-packet log line, rank resolution
+            # or any other per-request work, so a storm of rejects
+            # costs the control loop near nothing
+            return
         vmac = VirtualMac.decode(pkt.eth_dst)
         log.info(
             "SDNMPI communication from rank %s to rank %s (collective %s)",
@@ -541,9 +564,13 @@ class Router:
             "packet_in", dpid=event.dpid, in_port=event.in_port,
             src=pkt.eth_src, dst=pkt.eth_dst, mpi=True,
         )
+        # collective-member lookups are the BULK coalescer class: a
+        # storm of them must not starve latency-sensitive singles
+        bulk = vmac.coll_type != CollectiveType.P2P
         if self.coalesce:
             self._enqueue_route(
-                pkt.eth_src, pkt.eth_dst, true_dst, event, span=sp
+                pkt.eth_src, pkt.eth_dst, true_dst, event, span=sp,
+                bulk=bulk,
             )
         else:
             fdb = self.bus.request(ev.FindRouteRequest(pkt.eth_src, true_dst)).fdb
@@ -562,7 +589,7 @@ class Router:
 
     def _enqueue_route(
         self, src: str, dst: str, true_dst: str | None,
-        event: ev.EventPacketIn, span=NULL_SPAN,
+        event: ev.EventPacketIn, span=NULL_SPAN, bulk: bool = False,
     ) -> None:
         """Park one packet-in's route lookup for batched resolution.
 
@@ -578,7 +605,7 @@ class Router:
         self._pending.append(_PendingRoute(
             src, dst, true_dst, event.dpid, event.in_port, event.pkt,
             event.buffer_id, span=span, park=span.child("coalesce_park"),
-            t_parked=now,
+            t_parked=now, bulk=bulk,
         ))
         _m_queue_depth.set(len(self._pending))
         if not self._flushing and (
@@ -643,8 +670,7 @@ class Router:
         try:
             prev: tuple | None = None  # (batch, window, wsp, t_dispatched)
             while self._pending or prev is not None:
-                batch = self._pending[: self.config.coalesce_max_batch]
-                del self._pending[: len(batch)]
+                batch = self._next_window()
                 _m_queue_depth.set(len(self._pending))
                 window = None
                 wsp = NULL_SPAN
@@ -715,6 +741,44 @@ class Router:
                 # over the achieved end-to-end wall. ~1.0 = serial;
                 # >1 = device compute overlapped host decode+install
                 _m_overlap_gain.set((stage_wall + hidden_wall) / e2e)
+
+    def _next_window(self) -> list[_PendingRoute]:
+        """Compose the next coalescer window, priority-aware (ISSUE 11).
+
+        The window is capped at ``Config.coalesce_max_batch`` —
+        overflow stays parked and spills into the NEXT window of the
+        same flush loop, in arrival order, never one oversized window
+        (routes parked mid-flush by re-entering packet-outs join the
+        spill the same way; pinned by tests/test_serving.py). Within
+        the cap, latency-sensitive entries (plain unicast, MPI
+        point-to-point) are taken BEFORE bulk collective-member
+        lookups, so an alltoall storm's backlog cannot push a single-
+        pair request to the back of the flush; a single-class queue
+        degenerates to plain arrival-order slicing (the PR-10
+        behavior, byte-identical)."""
+        cap = max(1, self.config.coalesce_max_batch)
+        pending = self._pending
+        if len(pending) <= cap:
+            batch = pending[:]
+            pending.clear()
+            return batch
+        sel = [i for i, p in enumerate(pending) if not p.bulk][:cap]
+        if len(sel) < cap:
+            room = cap - len(sel)
+            bulk_idx = []
+            for i, p in enumerate(pending):
+                if p.bulk:
+                    bulk_idx.append(i)
+                    if len(bulk_idx) == room:
+                        break
+            sel = sorted(sel + bulk_idx)
+        taken = set(sel)
+        batch = [pending[i] for i in sel]
+        # ONE compaction pass (in place — flush/census/enqueue all hold
+        # this list): per-index deletes would make each flush O(cap x
+        # backlog) on exactly the storm backlog this queue exists for
+        pending[:] = [p for i, p in enumerate(pending) if i not in taken]
+        return batch
 
     def _dispatch_window(self, pairs, policy: str = "shortest", dirty=None):
         """Dispatch one window through the split-phase oracle API, or
@@ -1873,23 +1937,11 @@ class Router:
         deltas = deltas_since(last_v) if deltas_since else None
         if deltas is None:
             return None  # log broken (structural) or overflowed
-        dirty: set[int] = set()
-        for entry in deltas:
-            kind = entry[1]
-            if kind == "link+":
-                return None  # adds re-optimize globally (docstring)
-            if kind == "link-":
-                dirty.add(entry[2])
-                dirty.add(entry[3])
-            elif kind == "switch_upsert":
-                continue  # port-set refresh: the routed graph is unchanged
-            else:
-                # host moves / new switches shift endpoint resolution in
-                # ways the installed hop sets cannot express (the OLD
-                # edge switch of a moved host is not in the delta) —
-                # no narrowing
-                return None
-        return dirty
+        # ONE copy of the delete-narrowing kind rules, shared with the
+        # route cache's invalidation sweep (the proof lives there)
+        from sdnmpi_tpu.core.topology_db import narrowed_dirty_set
+
+        return narrowed_dirty_set(deltas)
 
     def _revalidate_flows(self) -> None:
         """Recompute installed routes after a topology change; tear down

@@ -66,6 +66,8 @@ class TopologyManager:
             shard_oracle=config.shard_oracle,
             ring_exchange=config.ring_exchange,
             delta_repair_threshold=config.delta_repair_threshold,
+            route_cache=config.route_cache,
+            route_cache_max_entries=config.route_cache_max_entries,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed
         #: link in bps: max of the sender's tx stream and the receiver's

@@ -105,6 +105,36 @@ def _entity_dict(obj: Any) -> Any:
     return out
 
 
+def narrowed_dirty_set(deltas) -> Optional[set]:
+    """The delete-narrowing rule, in ONE place (ISSUE 11 review).
+
+    Given :meth:`TopologyDB.deltas_since` entries, returns the dirtied
+    dpid set when the gap is coverable by pure link *deletes* (each
+    contributes its endpoint dpids; ``switch_upsert`` port-set
+    refreshes never change the routed graph and are ignorable), or
+    None when ANY delta kind defeats narrowing — link adds re-optimize
+    globally (a restored cable can shorten flows whose current detour
+    avoids both endpoints: the torus counterexample), and host /
+    switch membership deltas move endpoint resolution in ways installed
+    hop sets cannot express. Soundness of the delete case: a pair's
+    chosen shortest path changes under a delete only if it rode the
+    deleted link, so its hops contain both endpoints.
+
+    Both consumers — the Router's delta-narrowed revalidation
+    (control/router.py) and the route cache's invalidation sweep
+    (oracle/routecache.py) — share this helper so the proof cannot
+    drift between them."""
+    dirty: set = set()
+    for entry in deltas:
+        kind = entry[1]
+        if kind == "link-":
+            dirty.add(entry[2])
+            dirty.add(entry[3])
+        elif kind != "switch_upsert":
+            return None
+    return dirty
+
+
 #: delta-log depth: enough to cover any burst the oracle would repair
 #: incrementally (Config.delta_repair_threshold plus the switch-upsert
 #: chatter cabling changes produce) with a wide margin; overflow just
@@ -122,6 +152,8 @@ class TopologyDB:
         shard_oracle: bool = False,
         ring_exchange: bool = False,
         delta_repair_threshold: Optional[int] = None,
+        route_cache: bool = False,
+        route_cache_max_entries: int = 4096,
     ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
@@ -146,6 +178,17 @@ class TopologyDB:
         #: max link deltas the oracle absorbs by in-place repair before
         #: a full recompute (None = RouteOracle's default; 0 disables)
         self.delta_repair_threshold = delta_repair_threshold
+        #: memoized route cache (ISSUE 11, oracle/routecache.py): reaped
+        #: route windows and collective results served straight from the
+        #: memo on a repeat request, invalidated through this DB's own
+        #: delta log. None = off (the PR-10 dispatch path, byte-
+        #: identical). Works on BOTH backends — the py backend's cached
+        #: serves differential-test the cache itself.
+        self.route_cache = None
+        if route_cache:
+            from sdnmpi_tpu.oracle.routecache import RouteCache
+
+            self.route_cache = RouteCache(route_cache_max_entries)
         self._version = 0
         self._oracle = None  # lazily-created JAX oracle (oracle/engine.py)
         #: epoch + dirty-set log for the incremental oracle: one entry
@@ -421,7 +464,40 @@ class TopologyDB:
         decode is interleaved), unknown policies, and the pure-Python
         backend — come back as already-completed windows, so callers
         need no special cases.
+
+        With :attr:`route_cache` armed, a repeat request (same pairs,
+        same policy knobs, same topology/utilization epoch state)
+        returns the memoized reaped window WITHOUT dispatching anything
+        — bit-identical to the miss it memoizes, fed to the install
+        plane through the same completed-window contract the py backend
+        already exercises (oracle/routecache.py owns the invalidation
+        rules).
         """
+        cache = self.route_cache
+        key = None
+        if cache is not None:
+            cache.sync(self)
+            key = cache.window_key(
+                pairs, policy, kwargs.get("link_util"), kwargs
+            )
+            if key is not None:
+                hit = cache.lookup(key)
+                if hit is not None:
+                    from sdnmpi_tpu.oracle.batch import RouteWindow
+
+                    return RouteWindow(result=hit)
+        window = self._find_routes_batch_dispatch(pairs, policy, **kwargs)
+        if key is not None:
+            return cache.store_window(key, window, self._version)
+        return window
+
+    def _find_routes_batch_dispatch(
+        self,
+        pairs: list[tuple[str, str]],
+        policy: str = "shortest",
+        **kwargs,
+    ):
+        """The uncached dispatch leg (see find_routes_batch_dispatch)."""
         from sdnmpi_tpu.oracle.batch import RouteWindow, WindowRoutes
 
         if policy == "balanced":
@@ -489,7 +565,40 @@ class TopologyDB:
         fdb lists are never materialized unless the caller asks. On the
         JAX backend this is one resolve + one device program; the
         pure-Python backend loops (differential oracle).
+
+        With :attr:`route_cache` armed, a re-issued collective (same
+        member set, same policy and epoch state — production MPI's
+        common case) is served from the memo without touching the
+        oracle (ISSUE 11).
         """
+        cache = self.route_cache
+        key = None
+        if cache is not None:
+            cache.sync(self)
+            key = cache.collective_key(
+                macs, src_idx, dst_idx, policy,
+                kwargs.get("link_util"), kwargs,
+            )
+            if key is not None:
+                hit = cache.lookup(key)
+                if hit is not None:
+                    return hit
+        routes = self._find_routes_collective(
+            macs, src_idx, dst_idx, policy, **kwargs
+        )
+        if key is not None:
+            cache.store(key, routes, routes.hop_dpid)
+        return routes
+
+    def _find_routes_collective(
+        self,
+        macs: list,
+        src_idx,
+        dst_idx,
+        policy: str = "balanced",
+        **kwargs,
+    ):
+        """The uncached collective leg (see find_routes_collective)."""
         if self.backend == "jax":
             return self._jax_oracle().routes_collective(
                 self, macs, src_idx, dst_idx, policy, **kwargs
@@ -614,6 +723,16 @@ class TopologyDB:
             )
             phases.append(PhasePlan(p, sel, RouteWindow(result=routes)))
         return PhasedFlowProgram(k, pair_phase, phases)
+
+    def warm_serving(self, shapes=(8, 256)) -> dict:
+        """Pre-compile the serving path against the current topology
+        (ISSUE 11): the APSP refresh plus one window-extraction
+        dispatch per requested batch bucket, so the first packet-in
+        pays a dict lookup, not a trace+compile. No-op on the
+        pure-Python backend (nothing to compile)."""
+        if self.backend != "jax":
+            return {"warm_s": 0.0, "shapes": [], "max_len": 0}
+        return self._jax_oracle().warm_serving(self, shapes)
 
     # -- backend dispatch ------------------------------------------------
 

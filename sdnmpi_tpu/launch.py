@@ -103,6 +103,44 @@ def run_demo(controller: Controller, fabric, n_ranks: int) -> None:
     log.info("demo: %d ranks, alltoall kicked off, %d flows installed", n, flows)
 
 
+def run_serving_load(controller, fabric, args) -> dict:
+    """``--tenants`` mode: drive the booted controller with the
+    open-loop multi-tenant harness (control/loadgen.py) and log one
+    report line per tenant — the CLI face of bench config 14. Hosts
+    are split round-robin into tenants; every tenant offers
+    ``--offered-rate`` unicast lookups/s for the run."""
+    from sdnmpi_tpu.control.loadgen import LoadGen, TenantSpec
+
+    macs = sorted(fabric.hosts)
+    n = max(1, min(args.tenants, len(macs) // 2))
+    groups = [macs[i::n] for i in range(n)]
+    duration = args.duration if args.duration > 0 else 5.0
+    tenants = []
+    for i, group in enumerate(groups):
+        if len(group) < 2:
+            continue
+        name = f"tenant{i}"
+        for mac in group:
+            controller.router.admission.assign(mac, name)
+        tenants.append(TenantSpec(
+            name=name, rate=args.offered_rate,
+            n_requests=max(1, int(args.offered_rate * duration)),
+            macs=tuple(group),
+        ))
+    if not tenants:
+        log.warning("--tenants: not enough hosts for a tenant; skipping")
+        return {}
+    reports = LoadGen(controller, fabric).run(tenants)
+    for r in reports.values():
+        log.info(
+            "serving load %s: %.0f routes/s (offered %d, completed %d, "
+            "rejected %d) p50 %.2f ms p99 %.2f ms p999 %.2f ms",
+            r.tenant, r.routes_per_s, r.offered, r.completed,
+            r.rejected, r.p50_ms, r.p99_ms, r.p999_ms,
+        )
+    return reports
+
+
 def config_from_args(args) -> Config:
     listen = getattr(args, "listen", None)
     if listen and not args.observe_links:
@@ -143,6 +181,12 @@ def config_from_args(args) -> Config:
             args, "anomaly_latency_threshold", 0.0
         ),
         flight_p99_factor=getattr(args, "anomaly_p99_factor", 0.0),
+        route_cache=getattr(args, "route_cache", True),
+        admission_rate=getattr(args, "admission_rate", 0.0),
+        compile_cache_dir=getattr(args, "compile_cache_dir", None) or "",
+        warm_serving=getattr(args, "warm_serving", False),
+        # the serving-load mode measures the coalesced window pipeline
+        coalesce_routes=getattr(args, "tenants", 0) > 0,
     )
 
 
@@ -175,6 +219,15 @@ async def amain(args) -> None:
 
         init_multihost(*parse_distributed(args.distributed))
     config = config_from_args(args)
+    if config.compile_cache_dir:
+        # persistent compile cache (ISSUE 11): armed before ANY jax
+        # computation so every serving kernel lands in / loads from it
+        from sdnmpi_tpu.oracle.engine import enable_compile_cache
+
+        if enable_compile_cache(config.compile_cache_dir):
+            log.info(
+                "persistent compile cache at %s", config.compile_cache_dir
+            )
     if config.trace_log:
         from sdnmpi_tpu.utils.tracing import set_trace_sink
 
@@ -219,6 +272,16 @@ async def amain(args) -> None:
             spec.name,
             spec.n_switches,
             spec.n_hosts,
+        )
+    if config.warm_serving and config.oracle_backend == "jax":
+        # zero cold start (ISSUE 11): compile the serving path against
+        # the booted topology before the first packet-in arrives
+        warm = controller.topology_manager.topologydb.warm_serving(
+            shapes=(8, config.coalesce_max_batch)
+        )
+        log.info(
+            "serving path warmed in %.2f s (window buckets %s, hop "
+            "budget %d)", warm["warm_s"], warm["shapes"], warm["max_len"],
         )
 
     tasks = []
@@ -283,7 +346,14 @@ async def amain(args) -> None:
         with device_trace(config.profile_dir):
             if args.demo:
                 run_demo(controller, fabric, args.demo_ranks)
-            if args.duration > 0:
+            if getattr(args, "tenants", 0) > 0:
+                if spec is None:
+                    raise SystemExit(
+                        "--tenants needs the simulated fabric (no --listen)"
+                    )
+                # bounded serving-load run: report and exit
+                run_serving_load(controller, fabric, args)
+            elif args.duration > 0:
                 await asyncio.sleep(args.duration)
             else:
                 await asyncio.Future()
@@ -341,6 +411,20 @@ def _nonneg_int(s: str) -> int:
         raise argparse.ArgumentTypeError(
             f"must be >= 0, got {v} (0 = auto)"
         )
+    return v
+
+
+def _nonneg_float(s: str) -> float:
+    v = float(s)
+    if v < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {v} (0 = off)")
+    return v
+
+
+def _pos_float(s: str) -> float:
+    v = float(s)
+    if v <= 0:
+        raise argparse.ArgumentTypeError(f"must be > 0, got {v}")
     return v
 
 
@@ -486,6 +570,54 @@ def build_parser() -> argparse.ArgumentParser:
         "flaps, dropped/stalled/truncated installs, delayed stats — "
         "one chaos step per fabric clock tick; watch the recovery "
         "counters converge it back",
+    )
+    parser.add_argument(
+        "--tenants", type=_nonneg_int, default=0, metavar="N",
+        help="serving-load mode (ISSUE 11): split the simulated "
+        "fabric's hosts into N tenants and drive the live controller "
+        "with the open-loop multi-tenant harness (control/loadgen.py) "
+        "for --duration seconds (default 5), reporting per-tenant "
+        "routes/s and p50/p99/p999; implies route coalescing. 0 = off",
+    )
+    parser.add_argument(
+        "--offered-rate", type=_pos_float, default=200.0, metavar="R",
+        help="offered load per tenant in requests/second for --tenants "
+        "(open-loop: arrivals are scheduled from this rate alone, so "
+        "queueing past capacity shows up as tail latency, not as "
+        "silently throttled load)",
+    )
+    parser.add_argument(
+        "--route-cache", dest="route_cache", action="store_true",
+        help="memoize reaped route windows / collective results in "
+        "front of the oracle, invalidated through the topology delta "
+        "log (oracle/routecache.py; the default)",
+    )
+    parser.add_argument(
+        "--no-route-cache", dest="route_cache", action="store_false",
+        help="serve every request through the oracle dispatch path "
+        "(the PR-10 behavior, byte-identical — the differential "
+        "escape hatch)",
+    )
+    parser.set_defaults(route_cache=True)
+    parser.add_argument(
+        "--admission-rate", type=_nonneg_float, default=0.0,
+        metavar="RATE",
+        help="per-tenant admission rate in packet-ins/second "
+        "(control/admission.py): requests past a tenant's token bucket "
+        "drop at the door so one tenant's storm cannot starve the "
+        "rest. 0 = admit everything (the default)",
+    )
+    parser.add_argument(
+        "--compile-cache-dir", metavar="DIR",
+        help="persistent JAX compilation cache: compiled serving "
+        "kernels land on disk and a restarted controller reloads them "
+        "instead of re-compiling (kills the 18-22 s cold start)",
+    )
+    parser.add_argument(
+        "--warm-serving", action="store_true",
+        help="compile the serving path (APSP refresh + window "
+        "extraction buckets) against the booted topology at launch, "
+        "before the first packet-in arrives",
     )
     parser.add_argument("--trace-log", help="JSONL structured trace log path")
     parser.add_argument(

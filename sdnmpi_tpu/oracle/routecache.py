@@ -1,0 +1,279 @@
+"""Memoized route cache — the serving plane's fast path (ISSUE 11).
+
+Production MPI fleets re-issue the *same* collectives against a
+slowly-changing fabric, which makes route memoization the dominant
+serving win (the incremental-reuse argument of DeltaPath, arxiv
+1808.06893): a repeated route window or collective request should hit a
+dict, not the oracle's device pipeline.
+
+One :class:`RouteCache` sits in front of the oracle inside
+``TopologyDB`` (``find_routes_batch_dispatch`` /
+``find_routes_collective``), keyed by
+
+    (kind, policy, UtilPlane epoch, pair-set digest, policy-knob digest)
+
+with the **topology version deliberately outside the key**: instead of
+missing on every fabric mutation, the cache *invalidates through the
+TopologyDB delta log* (:meth:`sync`), so a link flap evicts only the
+entries whose stored routes actually rode the deleted link — the same
+delete-narrowing soundness argument the delta revalidation pass proves
+(control/router.py ``_reval_dirty_set``: a pair's chosen shortest path
+changes under a delete only if it rode the deleted link). Deltas the
+narrowing cannot cover soundly (link adds re-optimize globally; host /
+switch membership moves endpoint resolution; a broken/overflowed log)
+clear the cache — conservative, never stale. Utilization-seeded results
+(balanced / adaptive / collective) additionally carry the UtilPlane
+epoch in their key and are dropped on ANY topology delta: their choice
+depends on the whole DAG plus measured loads, so no per-entry narrowing
+is sound for them.
+
+A hit returns the stored, already-reaped result — the caller gets a
+completed :class:`~sdnmpi_tpu.oracle.batch.RouteWindow` and the install
+plane consumes the struct arrays exactly as it would a fresh reap, so
+hit and miss are bit-identical by construction (the stored object IS a
+prior miss's reap). Stored arrays are treated as immutable by every
+consumer (the Router's window installer only reads them).
+
+Observability rides the PR-4/PR-7 plane: ``route_cache_hits_total`` /
+``route_cache_misses_total`` / ``route_cache_evictions_total`` /
+``route_cache_entries``, and each hit emits a ``route_cache_hit`` child
+span under the ambient request span so flight-recorder bundles show
+hit-vs-miss serve paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Any, Optional
+
+import numpy as np
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+from sdnmpi_tpu.utils.tracing import start_child_span
+
+_m_hits = REGISTRY.counter(
+    "route_cache_hits_total",
+    "route window / collective requests served from the memo cache "
+    "(no oracle dispatch)",
+)
+_m_misses = REGISTRY.counter(
+    "route_cache_misses_total",
+    "cacheable requests that had to run the oracle",
+)
+_m_evictions = REGISTRY.counter(
+    "route_cache_evictions_total",
+    "entries dropped: LRU capacity plus delta-log invalidation",
+)
+_m_entries = REGISTRY.gauge(
+    "route_cache_entries", "live route-cache entries right now"
+)
+
+
+def _digest(parts) -> bytes:
+    """Stable 16-byte digest of an iterable of strings/ints/bytes —
+    compact keys for arbitrarily large pair sets (a 4096-pair window's
+    key must not retain 8192 MAC strings per entry). One join + one
+    hash update: the digest runs on EVERY cacheable request, hit or
+    miss, so per-part update calls would tax the ~100 us hit path the
+    cache exists to provide."""
+    return hashlib.blake2b(
+        b"\x1f".join(
+            p if isinstance(p, bytes) else str(p).encode() for p in parts
+        ),
+        digest_size=16,
+    ).digest()
+
+
+class _Entry:
+    __slots__ = ("result", "riders", "util_keyed")
+
+    def __init__(self, result, riders: frozenset, util_keyed: bool):
+        self.result = result
+        #: dpids the stored routes ride — the link-delete narrowing index
+        self.riders = riders
+        #: True for balanced/adaptive/collective results: invalidated on
+        #: ANY topology delta (no per-entry narrowing is sound for them)
+        self.util_keyed = util_keyed
+
+
+class RouteCache:
+    """LRU memo of reaped route results, delta-log invalidated."""
+
+    def __init__(self, max_entries: int = 4096) -> None:
+        self.max_entries = max(1, int(max_entries))
+        self._lru: OrderedDict[tuple, _Entry] = OrderedDict()
+        #: TopologyDB version the cache last synced to (None = never)
+        self._version: Optional[int] = None
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    # -- invalidation (the delta-log seam) --------------------------------
+
+    def sync(self, db) -> None:
+        """Absorb the TopologyDB's mutations since the last sync.
+
+        Pure link deletes narrow: only entries whose stored routes ride
+        a deleted link's endpoints are evicted (plus every util-keyed
+        entry — see module docstring). Any other delta kind — and a log
+        that no longer covers the gap — clears the cache: correctness
+        over reuse, exactly the reval pass's narrowing rules."""
+        version = db.version
+        if self._version is None:
+            self._version = version
+            return
+        if version == self._version:
+            return
+        deltas_since = getattr(db, "deltas_since", None)
+        deltas = deltas_since(self._version) if deltas_since else None
+        self._version = version
+        if not deltas:
+            # no basis (broken/overflowed log, or a duck-typed DB whose
+            # log does not cover the gap): correctness over reuse
+            self._clear()
+            return
+        # the ONE copy of the delete-narrowing kind rules (shared with
+        # the Router's delta-narrowed revalidation — see its docstring
+        # for the soundness proof): None = some delta defeats narrowing
+        from sdnmpi_tpu.core.topology_db import narrowed_dirty_set
+
+        dirty = narrowed_dirty_set(deltas)
+        if dirty is None:
+            self._clear()
+            return
+        doomed = [
+            key for key, e in self._lru.items()
+            if e.util_keyed or not dirty.isdisjoint(e.riders)
+        ]
+        for key in doomed:
+            del self._lru[key]
+        if doomed:
+            _m_evictions.inc(len(doomed))
+            _m_entries.set(len(self._lru))
+
+    def _clear(self) -> None:
+        if self._lru:
+            _m_evictions.inc(len(self._lru))
+            self._lru.clear()
+            _m_entries.set(0.0)
+
+    # -- keys --------------------------------------------------------------
+
+    @staticmethod
+    def _util_epoch(link_util) -> Optional[int]:
+        """Cache-key epoch of a utilization input: 0 for no measured
+        load (None / empty dict — the idle-fabric base is deterministic),
+        the published epoch for a device UtilPlane, and None —
+        *uncacheable* — for a non-empty host dict (no epoch discipline
+        to key on) OR a UtilPlane holding staged-but-unflushed samples:
+        an uncached dispatch flushes those into a NEW epoch and routes
+        on them (engine._normalized_base), so hitting on the pre-flush
+        epoch would serve pre-sample routes and break hit == miss."""
+        if not link_util:
+            return 0
+        epoch = getattr(link_util, "epoch", None)
+        if epoch is None:
+            return None  # raw host dict with live samples: no epoch
+        if getattr(link_util, "has_staged", False):
+            return None  # mid-pass: the next dispatch will re-epoch
+        return int(epoch)
+
+    def window_key(
+        self, pairs, policy: str, link_util, kwargs: dict
+    ) -> Optional[tuple]:
+        """Key for a batch route window, or None when uncacheable."""
+        if policy == "shortest":
+            epoch = 0  # shortest paths never read utilization
+        else:
+            epoch = self._util_epoch(link_util)
+            if epoch is None:
+                return None
+        knobs = _digest(
+            f"{k}={v!r}" for k, v in sorted(kwargs.items())
+            if k != "link_util"
+        )
+        return (
+            "window", policy, epoch,
+            _digest(f"{s}>{d}" for s, d in pairs), knobs,
+        )
+
+    def collective_key(
+        self, macs, src_idx, dst_idx, policy: str, link_util, kwargs: dict
+    ) -> Optional[tuple]:
+        """Key for a whole-collective request, or None when uncacheable."""
+        if policy == "shortest":
+            # deterministic next-hop paths never read utilization: a
+            # live epoch in the key would miss the identical re-issued
+            # collective on every Monitor pass for nothing (same rule
+            # as window_key)
+            epoch = 0
+        else:
+            epoch = self._util_epoch(link_util)
+            if epoch is None:
+                return None
+        knobs = _digest(
+            f"{k}={v!r}" for k, v in sorted(kwargs.items())
+            if k != "link_util"
+        )
+        pair_bytes = (
+            np.ascontiguousarray(src_idx, np.int32).tobytes()
+            + np.ascontiguousarray(dst_idx, np.int32).tobytes()
+        )
+        return (
+            "collective", policy, epoch,
+            _digest(list(macs) + [pair_bytes]), knobs,
+        )
+
+    # -- lookup / store ----------------------------------------------------
+
+    def lookup(self, key: tuple):
+        """The stored result for ``key`` (hit: LRU-touched, counted,
+        spanned) or None (miss counted)."""
+        e = self._lru.get(key)
+        if e is None:
+            _m_misses.inc()
+            return None
+        self._lru.move_to_end(key)
+        _m_hits.inc()
+        # the hit's own span stage: flight-recorder bundles distinguish
+        # cache-served requests from oracle-dispatched ones (ISSUE 11)
+        sp = start_child_span("route_cache_hit", entry=key[0], policy=key[1])
+        sp.end()
+        return e.result
+
+    def store(self, key: tuple, result, hop_dpid) -> Any:
+        """Memoize a reaped result (returns it, for reap-wrapper use).
+
+        ``hop_dpid`` is the result's hop array — the ridden-switch set
+        becomes the entry's link-delete narrowing index. A result
+        computed before a mutation that raced its reap is dropped
+        (store only when the cache is still synced to the version the
+        dispatch keyed under — the caller syncs before dispatch)."""
+        hops = np.asarray(hop_dpid)
+        riders = frozenset(int(d) for d in np.unique(hops[hops >= 0]))
+        self._lru[key] = _Entry(result, riders, key[1] != "shortest")
+        self._lru.move_to_end(key)
+        while len(self._lru) > self.max_entries:
+            self._lru.popitem(last=False)
+            _m_evictions.inc()
+        _m_entries.set(len(self._lru))
+        return result
+
+    def store_window(self, key: tuple, window, version: int):
+        """Wrap a dispatched :class:`RouteWindow` so its reap lands in
+        the cache (already-completed windows store eagerly). ``version``
+        is the TopologyDB version the dispatch keyed under: a reap that
+        lands after further mutations is served to its caller but NOT
+        memoized (its key would lie about the fabric it was computed
+        on)."""
+        from sdnmpi_tpu.oracle.batch import RouteWindow
+
+        def _landed(wr):
+            if self._version == version:
+                self.store(key, wr, wr.hop_dpid)
+            return wr
+
+        if window.done:
+            return RouteWindow(result=_landed(window.reap()))
+        return RouteWindow(reap=lambda: _landed(window.reap()))

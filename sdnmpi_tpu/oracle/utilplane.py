@@ -231,6 +231,17 @@ class UtilPlane:
         step applies per flushed batch, at the Monitor's cadence)."""
         self._staged[key] = float(bps)
 
+    @property
+    def has_staged(self) -> bool:
+        """True while samples are staged but not yet flushed into a
+        published epoch. The route cache (ISSUE 11) treats the plane as
+        UNCACHEABLE in this window: an uncached balanced dispatch would
+        flush these samples and route on them (engine._normalized_base),
+        so a hit keyed on the pre-flush epoch would silently serve
+        pre-sample routes — the hit==miss contract requires bypassing
+        the memo until the flush publishes."""
+        return bool(self._staged)
+
     #: halvings before a stale link is snapped to exact zero and its
     #: decay clock dropped (2^-20 of any real bps reading is noise)
     _DECAY_ROUNDS_MAX = 20

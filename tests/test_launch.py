@@ -340,3 +340,76 @@ class TestRecoveryFlags:
         asyncio.run(launch.amain(run._args(
             tmp_path, chaos=0, duration=0.3,
         )))
+
+
+class TestServingFlags:
+    """--tenants/--offered-rate/--route-cache/--no-route-cache/
+    --admission-rate/--compile-cache-dir/--warm-serving (ISSUE 11)."""
+
+    def test_serving_flag_defaults(self):
+        args = _parse([])
+        cfg = launch.config_from_args(args)
+        assert args.tenants == 0 and args.offered_rate == 200.0
+        assert cfg.route_cache is True
+        assert cfg.admission_rate == 0.0
+        assert cfg.compile_cache_dir == ""
+        assert cfg.warm_serving is False
+        assert cfg.coalesce_routes is False  # no serving-load mode
+
+    def test_serving_flags_map_to_config(self):
+        args = _parse([
+            "--tenants", "4", "--offered-rate", "750",
+            "--no-route-cache", "--admission-rate", "120",
+            "--compile-cache-dir", "/tmp/cc", "--warm-serving",
+        ])
+        cfg = launch.config_from_args(args)
+        assert args.tenants == 4 and args.offered_rate == 750.0
+        assert cfg.route_cache is False
+        assert cfg.admission_rate == 120.0
+        assert cfg.compile_cache_dir == "/tmp/cc"
+        assert cfg.warm_serving is True
+        # serving-load mode measures the coalesced window pipeline
+        assert cfg.coalesce_routes is True
+
+    def test_route_cache_last_flag_wins(self):
+        cfg = launch.config_from_args(
+            _parse(["--no-route-cache", "--route-cache"])
+        )
+        assert cfg.route_cache is True
+
+    def test_parser_rejects_invalid_serving_values(self):
+        for bad in (
+            ["--tenants", "-1"],
+            ["--offered-rate", "0"],
+            ["--offered-rate", "-10"],
+            ["--admission-rate", "-5"],
+        ):
+            with pytest.raises(SystemExit):
+                _parse(bad)
+
+    def test_serving_load_live_run(self, tmp_path):
+        """--tenants drives the open-loop harness against the live
+        launcher stack and exits after reporting."""
+        run = TestLiveRun()
+        asyncio.run(launch.amain(run._args(
+            tmp_path, demo=False, tenants=2, offered_rate=400.0,
+            duration=0.25, topo="fattree:4",
+        )))
+
+    def test_tenants_refused_in_listen_mode(self, tmp_path):
+        run = TestLiveRun()
+        with pytest.raises(SystemExit):
+            asyncio.run(launch.amain(run._args(
+                tmp_path, demo=False, tenants=2, listen="127.0.0.1:0",
+                duration=0.2,
+            )))
+
+    def test_warm_serving_live_run(self, tmp_path):
+        """--warm-serving + --compile-cache-dir boot, warm, and serve
+        demo traffic through the launcher runtime."""
+        run = TestLiveRun()
+        asyncio.run(launch.amain(run._args(
+            tmp_path, backend="jax", warm_serving=True,
+            compile_cache_dir=str(tmp_path / "cc"), duration=0.2,
+        )))
+        assert (tmp_path / "cc").is_dir()

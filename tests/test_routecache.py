@@ -1,0 +1,478 @@
+"""Route-cache correctness fences (ISSUE 11, oracle/routecache.py).
+
+The cache's contract is brutal: a hit must be bit-identical to the miss
+it memoizes, every post-churn serve must reflect the new epoch (no
+stale-route escape, fenced by a seeded churn replay against an uncached
+twin), Config.route_cache=False must restore the PR-10 dispatch path
+byte-identically, and the LRU must hold its configured bound.
+"""
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.fabric import Fabric
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.topogen import fattree
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+BALANCED_KW = dict(
+    link_util=None, alpha=1.0, chunk=4096, link_capacity=10e9,
+    ecmp_ways=4, rounds=2, dag_threshold=512,
+)
+
+
+def _dbs(backend="jax"):
+    cached = fattree(4).to_topology_db(
+        backend=backend, pad_multiple=8, route_cache=True
+    )
+    plain = fattree(4).to_topology_db(backend=backend, pad_multiple=8)
+    return cached, plain
+
+
+def _pairs(db, n=6):
+    macs = sorted(db.hosts)
+    return [(macs[i], macs[-(i + 1)]) for i in range(n)]
+
+
+def _counter(name):
+    return REGISTRY.get(name).value
+
+
+def assert_windows_equal(a, b):
+    np.testing.assert_array_equal(a.hop_dpid, b.hop_dpid)
+    np.testing.assert_array_equal(a.hop_port, b.hop_port)
+    np.testing.assert_array_equal(a.hop_len, b.hop_len)
+
+
+class TestBitIdentity:
+    def test_shortest_hit_equals_miss_and_uncached(self):
+        cached, plain = _dbs()
+        pairs = _pairs(cached)
+        h0 = _counter("route_cache_hits_total")
+        miss = cached.find_routes_batch_dispatch(pairs).reap()
+        hit = cached.find_routes_batch_dispatch(pairs).reap()
+        off = plain.find_routes_batch_dispatch(pairs).reap()
+        assert _counter("route_cache_hits_total") == h0 + 1
+        assert hit is miss  # the stored reap itself, no recompute
+        assert_windows_equal(hit, off)
+
+    def test_balanced_hit_equals_miss_and_uncached(self):
+        cached, plain = _dbs()
+        pairs = _pairs(cached)
+        miss = cached.find_routes_batch_dispatch(
+            pairs, policy="balanced", **BALANCED_KW
+        ).reap()
+        hit = cached.find_routes_batch_dispatch(
+            pairs, policy="balanced", **BALANCED_KW
+        ).reap()
+        off = plain.find_routes_batch_dispatch(
+            pairs, policy="balanced", **BALANCED_KW
+        ).reap()
+        assert hit is miss
+        assert_windows_equal(hit, off)
+        assert hit.max_congestion == off.max_congestion
+
+    def test_adaptive_hit_equals_miss_and_uncached(self):
+        kw = dict(
+            link_util=None, ugal_candidates=2, ugal_bias=1.0, alpha=1.0,
+            link_capacity=10e9, ecmp_ways=2,
+        )
+        cached, plain = _dbs()
+        pairs = _pairs(cached)
+        miss = cached.find_routes_batch_dispatch(
+            pairs, policy="adaptive", **kw
+        ).reap()
+        hit = cached.find_routes_batch_dispatch(
+            pairs, policy="adaptive", **kw
+        ).reap()
+        off = plain.find_routes_batch_dispatch(
+            pairs, policy="adaptive", **kw
+        ).reap()
+        assert hit is miss
+        assert_windows_equal(hit, off)
+
+    def test_collective_hit_equals_miss_and_uncached(self):
+        cached, plain = _dbs()
+        macs = sorted(cached.hosts)[:8]
+        src = np.array([0, 1, 2, 3], np.int32)
+        dst = np.array([4, 5, 6, 7], np.int32)
+        kw = dict(
+            link_util=None, alpha=1.0, link_capacity=10e9,
+            ecmp_ways=4, rounds=2,
+        )
+        miss = cached.find_routes_collective(macs, src, dst, "balanced", **kw)
+        hit = cached.find_routes_collective(macs, src, dst, "balanced", **kw)
+        off = plain.find_routes_collective(macs, src, dst, "balanced", **kw)
+        assert hit is miss
+        np.testing.assert_array_equal(hit.pair_sub, off.pair_sub)
+        np.testing.assert_array_equal(hit.hop_dpid, off.hop_dpid)
+        np.testing.assert_array_equal(hit.hop_port, off.hop_port)
+        np.testing.assert_array_equal(hit.final_port, off.final_port)
+
+    def test_py_backend_caches_identically(self):
+        """The cache sits above the backend split: the differential
+        oracle exercises the same memo machinery."""
+        cached, plain = _dbs(backend="py")
+        pairs = _pairs(cached)
+        miss = cached.find_routes_batch_dispatch(pairs).reap()
+        hit = cached.find_routes_batch_dispatch(pairs).reap()
+        off = plain.find_routes_batch_dispatch(pairs).reap()
+        assert hit is miss
+        assert_windows_equal(hit, off)
+
+
+class TestInvalidation:
+    def test_link_delete_evicts_riders_only(self):
+        """The DeltaPath narrowing: a link flap evicts only the entries
+        whose stored routes rode the deleted link; survivors still hit
+        AND still match a fresh uncached compute."""
+        cached, plain = _dbs()
+        macs = sorted(cached.hosts)
+        pair_a = [(macs[0], macs[1])]   # both under one edge switch
+        pair_b = [(macs[0], macs[-1])]  # crosses the core
+        wa = cached.find_routes_batch_dispatch(pair_a).reap()
+        wb = cached.find_routes_batch_dispatch(pair_b).reap()
+        # delete a core link ridden by pair_b but not pair_a
+        rider = int(wb.hop_dpid[0, 1])
+        nxt = int(wb.hop_dpid[0, 2])
+        link = cached.links[rider][nxt]
+        cached.delete_link(link)
+        plain.delete_link(plain.links[rider][nxt])
+        h0 = _counter("route_cache_hits_total")
+        hit_a = cached.find_routes_batch_dispatch(pair_a).reap()
+        assert _counter("route_cache_hits_total") == h0 + 1
+        assert hit_a is wa
+        assert_windows_equal(
+            hit_a, plain.find_routes_batch_dispatch(pair_a).reap()
+        )
+        # the rider was evicted: fresh compute, new-epoch route
+        fresh_b = cached.find_routes_batch_dispatch(pair_b).reap()
+        assert fresh_b is not wb
+        assert_windows_equal(
+            fresh_b, plain.find_routes_batch_dispatch(pair_b).reap()
+        )
+
+    def test_link_add_clears_everything(self):
+        """Adds re-optimize globally (the reval pass's torus
+        counterexample) — no narrowing, the whole cache drops."""
+        from sdnmpi_tpu.core.topology_db import Link, Port
+
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        cached.find_routes_batch_dispatch(pairs).reap()
+        assert len(cached.route_cache) == 1
+        dpids = sorted(cached.switches)
+        cached.add_link(Link(Port(dpids[0], 30), Port(dpids[-1], 30)))
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 0
+
+    def test_balanced_entries_drop_on_any_topology_delta(self):
+        """No per-entry narrowing is sound for utilization-seeded
+        policies: their choice depends on the whole DAG."""
+        cached, _ = _dbs()
+        macs = sorted(cached.hosts)
+        pairs = [(macs[0], macs[1])]  # one edge switch: tiny rider set
+        cached.find_routes_batch_dispatch(
+            pairs, policy="balanced", **BALANCED_KW
+        ).reap()
+        w = cached.find_routes_batch_dispatch(pairs).reap()
+        assert len(cached.route_cache) == 2
+        # delete a link NONE of the shortest window's routes ride
+        ridden = {int(d) for d in np.unique(w.hop_dpid) if d >= 0}
+        for src, dst_map in list(cached.links.items()):
+            for dst in list(dst_map):
+                if src not in ridden and dst not in ridden:
+                    cached.delete_link(dst_map[dst])
+                    break
+            else:
+                continue
+            break
+        cached.route_cache.sync(cached)
+        # the balanced entry died with the delta; the shortest one rode
+        # nothing deleted and survives
+        assert len(cached.route_cache) == 1
+
+    def test_host_membership_clears(self):
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        cached.find_routes_batch_dispatch(pairs).reap()
+        mac = sorted(cached.hosts)[-1]
+        cached.delete_host(mac)
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 0
+
+    def test_broken_delta_log_clears(self):
+        from sdnmpi_tpu.core.topology_db import Switch
+
+        cached, _ = _dbs()
+        cached.find_routes_batch_dispatch(_pairs(cached)).reap()
+        doomed = sorted(cached.switches)[-1]
+        cached.delete_switch(Switch.make(doomed))  # structural break
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 0
+
+    def test_seeded_churn_replay_no_stale_route_escape(self):
+        """Seeded link flaps; after EVERY step the cached serve must
+        equal the uncached twin bit-for-bit — the no-stale-route fence
+        of the acceptance criteria."""
+        rng = np.random.default_rng(7)
+        cached, plain = _dbs()
+        pairs = _pairs(cached, n=8)
+        for step in range(12):
+            links = [
+                (s, d)
+                for s, m in sorted(cached.links.items())
+                for d in sorted(m)
+            ]
+            s, d = links[rng.integers(len(links))]
+            link = cached.links[s][d]
+            if step % 3 == 2:
+                # restore a previously-deleted direction if any, else
+                # delete (adds exercise the clear-all path)
+                cached.add_link(link)
+                plain.add_link(plain.links[s][d])
+            else:
+                cached.delete_link(link)
+                plain.delete_link(plain.links[s][d])
+            got = cached.find_routes_batch_dispatch(pairs).reap()
+            want = plain.find_routes_batch_dispatch(pairs).reap()
+            assert_windows_equal(got, want)
+            # serve again: a hit, still fresh
+            again = cached.find_routes_batch_dispatch(pairs).reap()
+            assert_windows_equal(again, want)
+
+    def test_util_dict_with_samples_is_uncacheable(self):
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        kw = dict(BALANCED_KW)
+        dpid = sorted(cached.switches)[0]
+        kw["link_util"] = {(dpid, 1): 5e9}
+        cached.find_routes_batch_dispatch(
+            pairs, policy="balanced", **kw
+        ).reap()
+        assert len(cached.route_cache) == 0  # nothing memoized
+
+
+class TestBounds:
+    def test_eviction_bounds_under_max_entries(self):
+        db = fattree(4).to_topology_db(
+            backend="py", pad_multiple=8, route_cache=True,
+            route_cache_max_entries=4,
+        )
+        macs = sorted(db.hosts)
+        e0 = _counter("route_cache_evictions_total")
+        for i in range(10):
+            db.find_routes_batch_dispatch(
+                [(macs[i % len(macs)], macs[(i + 1) % len(macs)])]
+            ).reap()
+        assert len(db.route_cache) == 4
+        assert _counter("route_cache_evictions_total") == e0 + 6
+        assert REGISTRY.get("route_cache_entries").value == 4
+
+    def test_lru_keeps_the_hot_entry(self):
+        db = fattree(4).to_topology_db(
+            backend="py", pad_multiple=8, route_cache=True,
+            route_cache_max_entries=2,
+        )
+        macs = sorted(db.hosts)
+        hot = [(macs[0], macs[1])]
+        db.find_routes_batch_dispatch(hot).reap()
+        for i in range(2, 6):
+            db.find_routes_batch_dispatch([(macs[i], macs[0])]).reap()
+            db.find_routes_batch_dispatch(hot).reap()  # touch
+        h0 = _counter("route_cache_hits_total")
+        db.find_routes_batch_dispatch(hot).reap()
+        assert _counter("route_cache_hits_total") == h0 + 1
+
+    def test_direct_topologydb_defaults_off_config_defaults_on(self):
+        from sdnmpi_tpu.core.topology_db import TopologyDB
+
+        assert TopologyDB().route_cache is None
+        assert Config().route_cache is True
+        stack = Controller(Fabric(), Config(
+            oracle_backend="py", enable_monitor=False,
+        ))
+        assert stack.topology_manager.topologydb.route_cache is not None
+        off = Controller(Fabric(), Config(
+            oracle_backend="py", enable_monitor=False, route_cache=False,
+        ))
+        assert off.topology_manager.topologydb.route_cache is None
+
+
+MACS = [f"04:00:00:00:00:{i:02x}" for i in range(1, 9)]
+
+
+def _controller_stack(**config_kw):
+    fabric = Fabric()
+    for dpid in (1, 2, 3):
+        fabric.add_switch(dpid)
+    fabric.add_link(1, 1, 2, 1)
+    fabric.add_link(2, 2, 3, 1)
+    hosts = {
+        MACS[0]: fabric.add_host(MACS[0], 1, 2),
+        MACS[1]: fabric.add_host(MACS[1], 1, 3),
+        MACS[2]: fabric.add_host(MACS[2], 3, 2),
+        MACS[3]: fabric.add_host(MACS[3], 3, 3),
+    }
+    config_kw.setdefault("coalesce_window_s", 10.0)
+    controller = Controller(fabric, Config(
+        oracle_backend="py", enable_monitor=False, coalesce_routes=True,
+        **config_kw,
+    ))
+    controller.attach()
+    return fabric, controller, hosts
+
+
+class TestControllerByteIdentity:
+    def test_route_cache_off_restores_pr10_state_byte_identically(self):
+        """Same traffic + churn through a cache-on and a cache-off
+        stack: FDB, switch tables, and desired store must agree —
+        the Config.route_cache=False acceptance pin."""
+        scenario = [
+            (MACS[0], MACS[2]), (MACS[1], MACS[3]), (MACS[0], MACS[3]),
+        ]
+
+        def drive(route_cache: bool):
+            fabric, controller, hosts = _controller_stack(
+                route_cache=route_cache
+            )
+            for src, dst in scenario:
+                hosts[src].send(of.Packet(
+                    eth_src=src, eth_dst=dst, payload=b"x"
+                ))
+            fabric.remove_link(2, 2, 3, 1)   # flap
+            fabric.add_link(2, 2, 3, 1)
+            for src, dst in scenario:        # re-serve post-churn
+                hosts[src].send(of.Packet(
+                    eth_src=src, eth_dst=dst, payload=b"y"
+                ))
+            tables = {
+                dpid: sorted(
+                    repr((e.match, e.actions, e.priority))
+                    for e in sw.flow_table
+                )
+                for dpid, sw in fabric.switches.items()
+            }
+            return (
+                dict(controller.router.fdb.fdb),
+                tables,
+                controller.router.recovery.desired.flows,
+            )
+
+        assert drive(True) == drive(False)
+
+    def test_repeat_burst_serves_from_cache(self):
+        fabric, controller, hosts = _controller_stack()
+        h0 = _counter("route_cache_hits_total")
+        hosts[MACS[0]].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"a"
+        ))
+        # tear the flows down so the same pair faults in again
+        for dpid in (1, 2, 3):
+            controller.router.fdb.remove(dpid, MACS[0], MACS[2])
+        controller.router._del_flows_window(
+            [(d, MACS[0], MACS[2]) for d in (1, 2, 3)]
+        )
+        hosts[MACS[0]].send(of.Packet(
+            eth_src=MACS[0], eth_dst=MACS[2], payload=b"b"
+        ))
+        assert _counter("route_cache_hits_total") == h0 + 1
+        assert len(fabric.hosts[MACS[2]].received) == 2
+
+
+class TestUtilEpochKeying:
+    def test_balanced_misses_after_util_epoch_bump(self):
+        """A Monitor flush publishes a new UtilPlane epoch; balanced
+        entries keyed under the old epoch must stop hitting and the
+        fresh serve must match an uncached controller bit-for-bit."""
+        def build(route_cache):
+            fabric = Fabric()
+            for dpid in (1, 2, 3):
+                fabric.add_switch(dpid)
+            fabric.add_link(1, 1, 2, 1)
+            fabric.add_link(2, 2, 3, 1)
+            fabric.add_host(MACS[0], 1, 2)
+            fabric.add_host(MACS[2], 3, 2)
+            controller = Controller(fabric, Config(
+                enable_monitor=False, route_cache=route_cache,
+            ))
+            controller.attach()
+            return fabric, controller
+
+        fabric, controller = build(True)
+        _, plain = build(False)
+        pairs = [(MACS[0], MACS[2])]
+
+        def serve(c):
+            return c.bus.request(ev.DispatchRoutesBatchRequest(
+                pairs, policy="balanced"
+            )).window.reap()
+
+        serve(controller)  # binds the util plane (publishes epoch 1)
+        w0 = serve(controller)
+        assert serve(controller) is w0  # hit within the epoch
+        for c in (controller, plain):
+            # the plane binds on first base-cost use; stage + flush
+            c.bus.publish(ev.EventPortStats(1, 1, 0.0, 0.0, 0.0, 8e9))
+            c.bus.publish(ev.EventStatsFlush())
+        w1 = serve(controller)
+        assert w1 is not w0  # epoch moved: the old key cannot hit
+        assert_windows_equal(w1, serve(plain))
+
+    def test_staged_samples_bypass_the_memo_until_flushed(self):
+        """Between a Monitor sample landing and its flush, the plane is
+        UNCACHEABLE: the uncached dispatch flushes staged samples and
+        routes on them (engine._normalized_base), so a hit keyed on the
+        pre-flush epoch would serve pre-sample routes — hit == miss
+        demands bypassing the memo in that window."""
+        fabric = Fabric()
+        for dpid in (1, 2, 3):
+            fabric.add_switch(dpid)
+        fabric.add_link(1, 1, 2, 1)
+        fabric.add_link(2, 2, 3, 1)
+        fabric.add_host(MACS[0], 1, 2)
+        fabric.add_host(MACS[2], 3, 2)
+        controller = Controller(fabric, Config(enable_monitor=False))
+        controller.attach()
+        pairs = [(MACS[0], MACS[2])]
+
+        def serve():
+            return controller.bus.request(ev.DispatchRoutesBatchRequest(
+                pairs, policy="balanced"
+            )).window.reap()
+
+        serve()  # bind the plane
+        w0 = serve()
+        assert serve() is w0  # steady epoch: hits
+        # a sample lands mid-pass (staged, NOT yet flushed)
+        controller.bus.publish(ev.EventPortStats(1, 1, 0.0, 0.0, 0.0, 9e9))
+        plane = controller.topology_manager.util_plane
+        assert plane.has_staged
+        w1 = serve()  # uncacheable: dispatched fresh, flushes the sample
+        assert w1 is not w0
+        assert not plane.has_staged  # the dispatch published the epoch
+        w2 = serve()  # post-flush: cacheable again (miss, stored)
+        assert w2 is not w1  # w1 was computed under key=None: not memoized
+        assert serve() is w2
+
+    def test_shortest_collective_key_ignores_the_live_epoch(self):
+        """Re-issued shortest collectives — the cache's headline
+        workload — must not miss on every Monitor epoch bump: shortest
+        paths never read utilization, so their key pins epoch 0 (the
+        window_key rule)."""
+        from sdnmpi_tpu.oracle.routecache import RouteCache
+
+        class Plane:
+            epoch = 7
+            has_staged = False
+
+        rc = RouteCache()
+        k1 = rc.collective_key(["a", "b"], [0], [1], "shortest", Plane(), {})
+        Plane.epoch = 9
+        k2 = rc.collective_key(["a", "b"], [0], [1], "shortest", Plane(), {})
+        assert k1 == k2
+        kb = rc.collective_key(["a", "b"], [0], [1], "balanced", Plane(), {})
+        assert kb[2] == 9

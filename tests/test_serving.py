@@ -1,0 +1,446 @@
+"""Serving-plane fences at test scale (ISSUE 11).
+
+The config-14 machinery without a TPU: the open-loop load harness
+(control/loadgen.py), the admission gate (control/admission.py), the
+two-class coalescer queue and its max-batch spill (the PR's coalescer
+bugfix substrate), warm_serving, and the committed config-14 rows'
+regression-gate fence — so a serving-throughput or tail-latency
+regression fails tier-1 before it can burn a TPU suite.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.admission import AdmissionControl, TokenBucket
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.fabric import Fabric
+from sdnmpi_tpu.control.loadgen import LoadGen, TenantSpec, register_ranks
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.topogen import fattree
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+
+def serving_stack(k=4, **config_kw):
+    """A small wire-mode serving stack (the config-14 posture)."""
+    spec = fattree(k)
+    fabric = spec.to_fabric(wire=True)
+    config_kw.setdefault("proactive_collectives", False)
+    config = Config(
+        oracle_backend="py", enable_monitor=False, coalesce_routes=True,
+        coalesce_window_s=10.0, **config_kw,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    return fabric, controller
+
+
+# -- token bucket / admission gate ----------------------------------------
+
+class TestAdmission:
+    def test_token_bucket_rate_and_burst(self):
+        b = TokenBucket(rate=10.0, burst=3.0, now=0.0)
+        assert [b.take(0.0) for _ in range(4)] == [True] * 3 + [False]
+        assert not b.take(0.05)   # 0.5 tokens refilled: still short
+        assert b.take(0.1)        # 1 token refilled
+        assert not b.take(0.1)
+        b2 = TokenBucket(rate=10.0, burst=3.0, now=0.0)
+        time_passed = 100.0       # refill clamps at burst
+        assert [b2.take(time_passed) for _ in range(4)] == [True] * 3 + [False]
+
+    def test_admit_unlimited_by_default(self):
+        a = AdmissionControl()
+        assert all(a.admit("aa:bb", now=0.0) for _ in range(1000))
+
+    def test_per_tenant_buckets_and_rejection_counter(self):
+        a = AdmissionControl(rate=5.0, burst=2.0)
+        a.assign("m1", "t1")
+        a.assign("m2", "t1")  # same tenant, shared bucket
+        a.assign("m3", "t2")
+        r0 = a.rejections("t1")
+        got = [a.admit(m, now=0.0) for m in ("m1", "m2", "m1")]
+        assert got == [True, True, False]  # burst 2 shared across MACs
+        assert a.admit("m3", now=0.0)      # t2's own bucket untouched
+        assert a.rejections("t1") == r0 + 1
+
+    def test_per_tenant_rate_override(self):
+        a = AdmissionControl(rate=1.0, burst=1.0)
+        a.assign("fast", "vip", rate=100.0)
+        assert [a.admit("fast", now=i * 0.02) for i in range(4)].count(
+            True
+        ) == 4
+
+    def test_router_gate_drops_before_any_routing(self):
+        fabric, controller = serving_stack(
+            admission_rate=1.0, admission_burst=1.0
+        )
+        macs = sorted(fabric.hosts)
+        for m in macs[:2]:
+            controller.router.admission.assign(m, "t")
+        h = fabric.hosts[macs[0]]
+        pkt = of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"x")
+        h.send(pkt)  # burst token
+        flows_after_first = sum(
+            len(t) for t in controller.router.fdb.fdb.values()
+        )
+        # drain the installed flow so a packet-in would recur, then
+        # exceed the rate: the gate rejects before the coalescer parks
+        for dpid in list(controller.router.fdb.fdb):
+            controller.router.fdb.remove_switch(dpid)
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no, pkt, of.OFP_NO_BUFFER
+        ))
+        assert not controller.router._pending  # rejected, never parked
+        assert controller.router.admission.rejections("t") >= 1
+        assert flows_after_first > 0
+
+
+# -- two-class coalescer queue + max-batch spill ---------------------------
+
+class TestCoalescerClasses:
+    def test_window_spills_at_max_batch_in_arrival_order(self):
+        """The bugfix pin: overflow past coalesce_max_batch spills into
+        the NEXT window in arrival order — never one oversized window,
+        including for routes parked mid-flush."""
+        fabric, controller = serving_stack(coalesce_max_batch=8)
+        router = controller.router
+        sizes = []
+        handler = controller.bus._request_handlers[
+            ev.DispatchRoutesBatchRequest
+        ]
+
+        def counting(req, handler=handler):
+            sizes.append(len(req.pairs))
+            return handler(req)
+
+        controller.bus._request_handlers[
+            ev.DispatchRoutesBatchRequest
+        ] = counting
+        macs = sorted(fabric.hosts)
+        # park 19 lookups (bus publish parks; window_s is huge and the
+        # high-water flush inside publish is ALSO exercised at 8)
+        for i in range(19):
+            src, dst = macs[i % 8], macs[8 + (i % 8)]
+            h = fabric.hosts[src]
+            controller.bus.publish(ev.EventPacketIn(
+                h.dpid, h.port_no,
+                of.Packet(eth_src=src, eth_dst=dst, payload=b"s"),
+                of.OFP_NO_BUFFER,
+            ))
+        router.flush_routes()
+        assert not router._pending
+        assert max(sizes) <= 8  # never an oversized window
+        assert sum(sizes) == 19
+
+    def test_latency_sensitive_entries_jump_bulk_backlog(self):
+        """Window composition takes latency-sensitive entries before
+        bulk ones: a parked storm cannot push a single-pair request to
+        the back of the flush."""
+        from sdnmpi_tpu.control.router import _PendingRoute
+
+        fabric, controller = serving_stack(coalesce_max_batch=4)
+        router = controller.router
+
+        def pend(tag, i, bulk):
+            return _PendingRoute(
+                src=f"{tag}{i}", dst="d", true_dst=None, dpid=1,
+                in_port=1, pkt=None, buffer_id=of.OFP_NO_BUFFER,
+                bulk=bulk,
+            )
+
+        router._pending.extend(
+            [pend("bulk", i, True) for i in range(6)]
+            + [pend("ls", 0, False)]
+        )
+        first = router._next_window()
+        # the LS straggler made window 1 despite six earlier bulk parks
+        assert [p.src for p in first] == ["bulk0", "bulk1", "bulk2", "ls0"]
+        second = router._next_window()
+        assert [p.src for p in second] == ["bulk3", "bulk4", "bulk5"]
+        assert not router._pending
+
+    def test_single_class_queue_is_plain_arrival_order(self):
+        from sdnmpi_tpu.control.router import _PendingRoute
+
+        fabric, controller = serving_stack(coalesce_max_batch=3)
+        router = controller.router
+        router._pending.extend(
+            _PendingRoute(
+                src=f"u{i}", dst="d", true_dst=None, dpid=1, in_port=1,
+                pkt=None, buffer_id=of.OFP_NO_BUFFER,
+            )
+            for i in range(5)
+        )
+        assert [p.src for p in router._next_window()] == ["u0", "u1", "u2"]
+        assert [p.src for p in router._next_window()] == ["u3", "u4"]
+
+    def test_mpi_collective_packet_in_parks_as_bulk(self):
+        from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+        fabric, controller = serving_stack()
+        macs = sorted(fabric.hosts)[:4]
+        register_ranks(fabric, controller.config, macs)
+        router = controller.router
+        vmac = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=vmac,
+                      eth_type=of.ETH_TYPE_IP),
+            of.OFP_NO_BUFFER,
+        ))
+        assert router._pending and router._pending[-1].bulk
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"u"),
+            of.OFP_NO_BUFFER,
+        ))
+        assert not router._pending[-1].bulk
+        router.flush_routes()
+
+
+# -- the open-loop harness -------------------------------------------------
+
+class TestLoadGen:
+    def test_reports_cover_offered_load(self):
+        fabric, controller = serving_stack()
+        macs = sorted(fabric.hosts)
+        groups = [tuple(macs[:4]), tuple(macs[4:8])]
+        tenants = []
+        for i, g in enumerate(groups):
+            for m in g:
+                controller.router.admission.assign(m, f"t{i}")
+            tenants.append(TenantSpec(
+                f"t{i}", rate=2000.0, n_requests=40, macs=g,
+            ))
+        reports = LoadGen(controller, fabric).run(tenants)
+        for i in range(2):
+            r = reports[f"t{i}"]
+            assert r.offered == 40
+            assert r.completed + r.rejected == r.offered
+            assert r.rejected == 0  # no admission armed
+            assert r.routes_per_s > 0
+            assert 0 <= r.p50_ms <= r.p99_ms <= r.p999_ms
+
+    def test_alltoall_tenant_fires_vmac_pairs(self):
+        fabric, controller = serving_stack()
+        macs = tuple(sorted(fabric.hosts)[:4])
+        ranks = register_ranks(fabric, controller.config, macs)
+        reports = LoadGen(controller, fabric).run([TenantSpec(
+            "agg", rate=5000.0, n_requests=24, kind="alltoall",
+            macs=macs, ranks=tuple(ranks),
+        )])
+        r = reports["agg"]
+        assert r.completed == 24
+        # the reactive per-pair serves installed real vMAC flows
+        vmac_flows = [
+            dst for t in controller.router.fdb.fdb.values() for _, dst in t
+        ]
+        from sdnmpi_tpu.protocol.vmac import is_sdn_mpi_addr
+
+        assert any(is_sdn_mpi_addr(d) for d in vmac_flows)
+
+    def test_admission_bounds_victim_tail_under_storm(self):
+        """The aggressor-storm fence at test scale: with the gate on,
+        the victim's p99 stays bounded and the aggressor is clipped;
+        with it off, the open-loop backlog inflates the victim's tail."""
+        def storm(admission_rate):
+            # burst deep enough that the victim's catch-up bunches
+            # (open-loop arrivals injected late, back-to-back, behind a
+            # long flush) pass the gate; the storm still clips hard
+            fabric, controller = serving_stack(
+                admission_rate=admission_rate, admission_burst=16.0,
+            )
+            macs = sorted(fabric.hosts)
+            vic, agg = tuple(macs[:2]), tuple(macs[4:10])
+            for m in vic:
+                controller.router.admission.assign(m, "victim")
+            for m in agg:
+                controller.router.admission.assign(m, "aggressor")
+            ranks = register_ranks(fabric, controller.config, agg)
+            reports = LoadGen(controller, fabric).run([
+                TenantSpec("victim", rate=50.0, n_requests=25, macs=vic),
+                TenantSpec("aggressor", rate=6000.0, n_requests=1500,
+                           kind="alltoall", macs=agg, ranks=tuple(ranks)),
+            ])
+            return reports["victim"], reports["aggressor"]
+
+        vic_off, agg_off = storm(admission_rate=0.0)
+        # the uniform per-tenant cap sits above the victim's trickle
+        # and far under the aggressor's offered storm
+        vic_on, agg_on = storm(admission_rate=100.0)
+        assert agg_off.rejected == 0
+        assert agg_on.rejected > 0          # the gate actually clipped
+        assert vic_on.completed == 25       # victim under its own rate
+        # bounded vs unbounded: the unprotected run's backlog dwarfs
+        # the protected run's tail (config 14 pins the 2x-unloaded bar
+        # at bench scale; here the ORDERING is the machine-size-proof
+        # fence)
+        assert vic_on.p99_ms < vic_off.p99_ms
+
+
+class TestTelemetryExposure:
+    def test_serving_metrics_ride_the_snapshot(self):
+        """The ISSUE-11 instruments are registered and visible through
+        the one-registry telemetry snapshot (and therefore the RPC
+        mirror and Prometheus exposition, which render exactly it)."""
+        fabric, controller = serving_stack(
+            admission_rate=1.0, admission_burst=1.0
+        )
+        macs = sorted(fabric.hosts)
+        controller.router.admission.assign(macs[0], "t0")
+        h = fabric.hosts[macs[0]]
+        pkt = of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"m")
+        h.send(pkt)
+        controller.bus.publish(ev.EventPacketIn(  # second: rejected
+            h.dpid, h.port_no, pkt, of.OFP_NO_BUFFER
+        ))
+        snap = controller.telemetry()
+        counters = snap["counters"]
+        for name in (
+            "route_cache_hits_total", "route_cache_misses_total",
+            "route_cache_evictions_total",
+        ):
+            assert name in counters
+        assert "route_cache_entries" in snap["gauges"]
+        assert counters["admission_rejections_total{tenant=t0}"] >= 1
+        # the exposition renders the same snapshot without error
+        from sdnmpi_tpu.api.telemetry import render
+
+        text = render(snap)
+        assert "route_cache_hits_total" in text
+        assert 'admission_rejections_total{tenant="t0"}' in text
+
+
+# -- warm serving / zero cold start ---------------------------------------
+
+class TestWarmServing:
+    def test_warm_serving_compiles_the_window_buckets(self):
+        db = fattree(4).to_topology_db(backend="jax", pad_multiple=8)
+        out = db.warm_serving(shapes=(3, 100))
+        assert out["shapes"] == [8, 104]  # bucket-rounded
+        assert out["max_len"] >= 8 and out["max_len"] % 8 == 0
+        assert out["warm_s"] > 0
+        # the warmed path serves immediately
+        macs = sorted(db.hosts)
+        wr = db.find_routes_batch_dispatch([(macs[0], macs[-1])]).reap()
+        assert int(wr.hop_len[0]) > 0
+
+    def test_warm_serving_warms_the_sharded_kernel_under_shard_oracle(
+        self, virtual_mesh
+    ):
+        """With shard_oracle armed, warm_serving must compile the
+        SHARDED window extraction (shard-divisible buckets), not the
+        single-chip twin the serving path never dispatches — and a
+        subsequent sharded dispatch serves correctly."""
+        from tests.conftest import N_VIRTUAL_DEVICES
+
+        db = fattree(4).to_topology_db(
+            backend="jax", pad_multiple=8,
+            mesh_devices=N_VIRTUAL_DEVICES, shard_oracle=True,
+        )
+        out = db.warm_serving(shapes=(3,))
+        assert out["shapes"] == [8]  # lcm(8, mesh) buckets
+        macs = sorted(db.hosts)
+        wr = db.find_routes_batch_dispatch([(macs[0], macs[-1])]).reap()
+        assert int(wr.hop_len[0]) > 0
+
+    def test_warm_serving_py_backend_is_a_noop(self):
+        db = fattree(4).to_topology_db(backend="py")
+        assert db.warm_serving() == {
+            "warm_s": 0.0, "shapes": [], "max_len": 0
+        }
+
+    def test_enable_compile_cache_round_trips(self, tmp_path):
+        import jax
+
+        from sdnmpi_tpu.oracle.engine import enable_compile_cache
+
+        assert not enable_compile_cache("")
+        assert enable_compile_cache(str(tmp_path / "cc"))
+        assert (tmp_path / "cc").is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "cc")
+
+
+# -- config-14 machinery + regression-gate fences --------------------------
+
+class TestConfig14Machinery:
+    def test_registered_and_schema_checked(self):
+        from benchmarks.run import CONFIGS, check_rows
+
+        assert any(name == "14" for name, _ in CONFIGS)
+        rows = [
+            {"config": "14", "metric": "serving_routes_per_s",
+             "value": 1500.0, "unit": "routes/s", "vs_baseline": 1.1,
+             "tenants": 4},
+            {"config": "14b", "metric": "cache_hit_window_us",
+             "value": 150.0, "unit": "us", "vs_baseline": 12.0},
+            {"config": "14c", "metric": "victim_p99_ms", "value": 6.0,
+             "unit": "ms", "vs_baseline": 50.0},
+            {"config": "14d", "metric": "first_route_after_restart_ms",
+             "value": 2500.0, "unit": "ms", "vs_baseline": 1.5},
+        ]
+        assert check_rows(rows) == []
+
+    def test_committed_rows_pass_the_regression_gate(self):
+        """The committed suite carries the serving rows with the
+        acceptance pins (cache hit >= 10x the miss path; warm restart
+        first route < 5 s; victim p99 improved by admission), and the
+        gate passes a matching fresh row while failing a degraded one."""
+        import json
+        import pathlib
+
+        from benchmarks import run as bench_run
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        suite = json.loads((root / "BENCH_suite.json").read_text())
+        rows = {
+            r["config"]: r for r in suite
+            if r.get("config", "").startswith("14") and "error" not in r
+        }
+        assert rows["14"]["metric"] == "serving_routes_per_s"
+        assert rows["14"]["value"] > 0
+        cache = rows["14b"]
+        assert cache["vs_baseline"] >= 10.0  # the acceptance pin
+        storm = rows["14c"]
+        assert storm["vs_baseline"] > 1.0    # admission beats unprotected
+        assert storm["value"] <= 2.0 * storm["unloaded_p99_ms"]
+        restart = rows["14d"]
+        assert restart["value"] < 5000.0     # first route in < 5 s
+        fresh = [dict(cache)]
+        assert bench_run.check_regression(fresh, suite) == []
+        bad = [dict(cache, vs_baseline=cache["vs_baseline"] * 0.5)]
+        assert bench_run.check_regression(bad, suite)
+
+    def test_cache_fence_and_speed_helpers_at_test_scale(self):
+        """config 14's in-config fence + hit/miss measurement run on a
+        tiny stack (the machinery fails loudly here before a TPU run)."""
+        from benchmarks.config14_serving import (
+            fence_cache_bit_identity,
+            measure_cache_hit_speed,
+        )
+
+        fabric, controller = serving_stack(k=4)
+        macs = sorted(fabric.hosts)
+        pairs = [(macs[i], macs[-(i + 1)]) for i in range(6)]
+        fence_cache_bit_identity(controller, pairs)
+        hit_us, miss_us = measure_cache_hit_speed(
+            controller, pairs, iters=5
+        )
+        assert hit_us > 0 and miss_us > 0
+
+    @pytest.mark.slow
+    def test_first_route_probe_restart_under_5s(self, tmp_path):
+        """The full restart probe (two real subprocesses sharing a
+        persistent compile cache): warm first-route-after-restart must
+        land under the 5 s acceptance bar at test scale."""
+        from benchmarks.config14_serving import measure_restart
+
+        cold_ms, cold = measure_restart(str(tmp_path), k=4)
+        warm_ms, warm = measure_restart(str(tmp_path), k=4)
+        assert warm["served"] and cold["served"]
+        assert warm_ms < 5000.0
+        assert warm["route_ms"] < 1000.0
