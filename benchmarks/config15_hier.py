@@ -1,0 +1,247 @@
+"""Config 15: hierarchical two-level oracle (oracle/hier.py, ISSUE 13)
+— route a 65k-switch fabric on an 8-way mesh by escaping the dense
+[V, V] ceiling.
+
+Two datapoints:
+
+- **Primary** (row 15): an alltoall routed over a ``fattree(64,
+  pods=1008)`` — 65,536 switches, ~1M-host class when fully populated
+  (the bench attaches one host per edge switch and spreads the ranks
+  across pods) — through the hierarchical oracle on the device mesh.
+  This is a shape NO dense path reaches: the [V, V] f32 plane alone is
+  16 GB before double-buffering, while the hierarchy's serving tensors
+  (pod blocks + the lazily-materialized border-distance rows) shard
+  one block-shard per device. vs_baseline = dense [V, V] plane bytes /
+  peak per-device hierarchical oracle bytes — the memory-headroom
+  ratio the ROADMAP's [V, V]-ceiling item asks for (the acceptance
+  fence asserts per-device < 1/8 of the dense plane IN-CONFIG before
+  any number is emitted). Route validity is spot-checked against the
+  live link set, and the dense-vs-hier length fence runs at small V
+  first — a silently-wrong hierarchical route fails the config instead
+  of emitting a pretty number.
+- **Refresh twin** (row 15b): the config-13 pod shape (fat-tree k=56,
+  3,920 switches) refreshed through the dense SHARDED oracle (tensorize
+  + row-sharded APSP distances/next hops — the PR-9/10 path) vs the
+  full hierarchical build (pod blocks + level 2 + every border row
+  materialized). vs_baseline = dense / hier; the acceptance bound is
+  hier no slower than 1.5x dense (vs_baseline >= 0.667), asserted
+  in-config.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+K_DC = 64
+PODS_DC = 1008  # 1024 cores + 1008 * 64 = 65,536 switches
+HOSTS_PER_EDGE_DC = 1
+N_RANKS_DC = 128
+K_POD = 56  # the config-13 pod shape (3,920 switches)
+
+#: acceptance bounds (tests/test_hier.py fences these at test scale)
+MEM_HEADROOM_MIN = 8.0
+REFRESH_RATIO_MAX = 1.5
+
+
+def pick_mesh_devices(requested: int = 0) -> int:
+    from benchmarks.config13_shard import pick_mesh_devices as pick
+
+    return pick(requested)
+
+
+def fence_small() -> str:
+    """The dense-vs-hier bit-identity fence at small V: identical path
+    LENGTHS (and valid hops) on a fat-tree and a partitioner-fallback
+    torus, or die. Returns the fence tag recorded on the primary row."""
+    from sdnmpi_tpu.topogen import fattree, torus
+
+    for spec in (fattree(8), torus((4, 4))):
+        dense = spec.to_topology_db(backend="jax")
+        hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+        hosts = sorted(dense.hosts)[:12]
+        pairs = [(a, b) for a in hosts for b in hosts if a != b]
+        fd = dense.find_routes_batch(pairs)
+        fh = hier.find_routes_batch(pairs)
+        assert [len(x) for x in fd] == [len(y) for y in fh], (
+            f"hier path lengths drifted from dense on {spec.name}"
+        )
+        for fdb in fh:
+            for (a, pa), (b, _) in zip(fdb, fdb[1:]):
+                link = hier.links.get(a, {}).get(b)
+                assert link is not None and link.src.port_no == pa, (
+                    f"invalid hier hop on {spec.name}"
+                )
+    return "dense==hier lengths @ fattree8 + torus4x4"
+
+
+def hier_problem(
+    k: int, pods: int, hosts_per_edge: int, n_ranks: int,
+    mesh_devices: int,
+):
+    """Build the hierarchical-oracle alltoall problem at one shape —
+    shared by the bench rows and the test-scale machinery fence
+    (tests/test_hier.py). Returns (db, oracle, macs, src_idx,
+    dst_idx)."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k, hosts_per_edge=hosts_per_edge, pods=pods)
+    db = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=mesh_devices,
+    )
+    hosts = sorted(db.hosts)
+    stride = max(1, len(hosts) // n_ranks)
+    macs = hosts[::stride][:n_ranks]
+    n = len(macs)
+    src, dst = np.meshgrid(
+        np.arange(n, dtype=np.int32), np.arange(n, dtype=np.int32),
+        indexing="ij",
+    )
+    off = src != dst
+    return db, db._jax_oracle(), macs, src[off], dst[off]
+
+
+def validate_routes(db, macs, routes, src_idx, dst_idx, sample=64):
+    """Spot-check routed paths against the live link set + endpoint
+    attachment; every pair must be routed (the fabric is connected)."""
+    assert routes.routed_mask().all(), "unrouted pairs on a connected fabric"
+    rng = np.random.default_rng(0)
+    for kk in rng.choice(routes.n_pairs, min(sample, routes.n_pairs),
+                         replace=False):
+        fdb = routes.fdb(int(kk))
+        assert fdb, "empty fdb for a routed pair"
+        for (a, pa), (b, _) in zip(fdb, fdb[1:]):
+            link = db.links.get(a, {}).get(b)
+            assert link is not None and link.src.port_no == pa
+        dst_host = db.hosts[macs[int(dst_idx[kk])]]
+        assert fdb[-1] == (dst_host.port.dpid, dst_host.port.port_no)
+
+
+def measure_headline(
+    k: int = K_DC, pods: int = PODS_DC,
+    hosts_per_edge: int = HOSTS_PER_EDGE_DC, n_ranks: int = N_RANKS_DC,
+    mesh_devices: int = 0, iters: int = 3,
+) -> dict:
+    """The primary datapoint at a parameterized shape (the test fence
+    runs it tiny). Returns the row dict (emit-ready minus metric)."""
+    from sdnmpi_tpu.shardplane.hier import hier_device_bytes
+
+    t0 = time.perf_counter()
+    db, oracle, macs, src_idx, dst_idx = hier_problem(
+        k, pods, hosts_per_edge, n_ranks, mesh_devices
+    )
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state = oracle.refresh(db)
+    refresh_s = time.perf_counter() - t0
+    log(
+        f"config15: V={state.v} pods={state.n_pods} "
+        f"borders={state.n_borders} build {build_s:.1f}s "
+        f"refresh {refresh_s:.1f}s"
+    )
+
+    t0 = time.perf_counter()
+    routes = db.find_routes_collective(
+        macs, src_idx, dst_idx, policy="shortest"
+    )
+    first_route_s = time.perf_counter() - t0
+    validate_routes(db, macs, routes, src_idx, dst_idx)
+
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        db.find_routes_collective(macs, src_idx, dst_idx, policy="shortest")
+        samples.append(time.perf_counter() - t0)
+    route_s = float(np.median(samples))
+
+    mesh = oracle._dag_mesh()
+    peak_dev = hier_device_bytes(state, mesh)
+    if peak_dev == 0:  # no mesh: the whole (host) hierarchy is the peak
+        peak_dev = state.oracle_bytes()
+    dense_plane = state.v * state.v * 4
+    return {
+        "value": route_s * 1e3,
+        "vs_baseline": dense_plane / max(peak_dev, 1),
+        "n_switches": state.v,
+        "n_pods": state.n_pods,
+        "n_borders": state.n_borders,
+        "n_ranks": len(macs),
+        "n_pairs": int(len(src_idx)),
+        "refresh_ms": refresh_s * 1e3,
+        "first_route_ms": first_route_s * 1e3,
+        "peak_device_bytes": int(peak_dev),
+        "dense_plane_bytes": int(dense_plane),
+        "mesh_devices": mesh_devices,
+    }
+
+
+def measure_refresh_twin(k: int = K_POD, mesh_devices: int = 0) -> dict:
+    """Dense sharded refresh vs full hierarchical build at the pod
+    shape — the acceptance's 1.5x refresh bound."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    dense_db = spec.to_topology_db(
+        backend="jax", mesh_devices=mesh_devices,
+        shard_oracle=mesh_devices > 0,
+    )
+    t0 = time.perf_counter()
+    dense_db._jax_oracle().refresh(dense_db)
+    import jax
+
+    jax.block_until_ready(dense_db._jax_oracle()._next_d)
+    dense_s = time.perf_counter() - t0
+
+    hier_db = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=mesh_devices,
+    )
+    t0 = time.perf_counter()
+    oracle = hier_db._jax_oracle()
+    state = oracle.refresh(hier_db)
+    state.ensure_rows(range(state.n_pods))  # the full border plane
+    hier_s = time.perf_counter() - t0
+    return {
+        "value": hier_s * 1e3,
+        "vs_baseline": dense_s / max(hier_s, 1e-9),
+        "dense_refresh_ms": dense_s * 1e3,
+        "n_switches": state.v,
+        "n_borders": state.n_borders,
+        "mesh_devices": mesh_devices,
+    }
+
+
+def main() -> None:
+    import jax
+
+    mesh_devices = pick_mesh_devices()
+    platform = (
+        "tpu" if jax.default_backend() == "tpu" else "cpu-virtual-mesh"
+    )
+    fence = fence_small()
+    log("config15: small-V dense-vs-hier fence passed")
+
+    row = measure_headline(mesh_devices=mesh_devices)
+    assert row["peak_device_bytes"] * MEM_HEADROOM_MIN < row[
+        "dense_plane_bytes"
+    ], "per-device hier memory exceeds 1/8 of the dense [V, V] plane"
+    emit(
+        "hier_fattree64k_route_ms", row.pop("value"), "ms",
+        row.pop("vs_baseline"), fence=fence, platform=platform, **row,
+    )
+
+    twin = measure_refresh_twin(mesh_devices=mesh_devices)
+    assert twin["vs_baseline"] >= 1.0 / REFRESH_RATIO_MAX, (
+        f"hier refresh {1 / twin['vs_baseline']:.2f}x slower than the "
+        f"dense sharded refresh (bound {REFRESH_RATIO_MAX}x)"
+    )
+    emit(
+        "hier_v4k_refresh_ms", twin.pop("value"), "ms",
+        twin.pop("vs_baseline"), platform=platform, **twin,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ CONFIGS = [
     ("12", [sys.executable, "-m", "benchmarks.config12_schedule"]),
     ("13", [sys.executable, "-m", "benchmarks.config13_shard"]),
     ("14", [sys.executable, "-m", "benchmarks.config14_serving"]),
+    ("15", [sys.executable, "-m", "benchmarks.config15_hier"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

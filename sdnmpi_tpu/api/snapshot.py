@@ -22,8 +22,20 @@ SNAPSHOT_VERSION = 1
 
 
 def snapshot_controller(controller) -> dict:
+    db = controller.topology_manager.topologydb
+    # the route-cache memo rides the checkpoint beside the compile
+    # cache (ISSUE 13 satellite): surviving (shortest-policy) entries
+    # serialize with a topology digest + format version and re-seed a
+    # restarted controller's cache, so the first repeat collective
+    # after a restart is a hit, not a dispatch. Absent/None when the
+    # cache is off — restores treat it as optional.
+    route_cache = (
+        db.route_cache.snapshot_entries(db)
+        if db.route_cache is not None else None
+    )
     return {
         "version": SNAPSHOT_VERSION,
+        "route_cache": route_cache,
         "topology": controller.topology_manager.topologydb.to_dict(),
         "fdb": controller.router.fdb.to_dict(),
         "rankdb": controller.process_manager.rankdb.to_dict(),
@@ -83,6 +95,15 @@ def restore_controller(controller, snapshot: dict) -> None:
     controller.topology_manager.restore_link_util(
         {(dpid, port): bps for dpid, port, bps in snapshot.get("link_util", [])}
     )
+
+    # Re-seed the route-cache memo BEFORE any re-routing below: the
+    # reinstall passes then hit the restored entries (hit == miss
+    # bit-identical, so this is purely a latency win). The restore is
+    # version- AND topology-digest-guarded inside restore_entries — a
+    # controller that discovered a different fabric restores nothing.
+    memo = snapshot.get("route_cache")
+    if memo and db.route_cache is not None:
+        db.route_cache.restore_entries(memo, db)
 
     # Flows are restored by *re-routing* the snapshotted (src, dst) pairs
     # and pushing real FlowMods to whatever datapaths are currently live —

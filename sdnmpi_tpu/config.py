@@ -134,6 +134,24 @@ class Config:
     #: and with the knob ON routes stay bit-identical to it
     #: (tests/test_shardplane.py pins both).
     ring_exchange: bool = False
+    #: hierarchical two-level oracle (ISSUE 13, oracle/hier.py +
+    #: shardplane/hier.py): replace every dense [V, V] plane with
+    #: dense per-pod blocks (the topology's PodMap annotation, or a
+    #: partitioner fallback) plus a compressed border-skeleton layer
+    #: composed at route time — O(pods x pod_size^2) memory instead of
+    #: O(V^2), which is what routes a 65k-switch fabric on an 8-chip
+    #: slice (bench config 15). Path LENGTHS stay bit-identical to the
+    #: dense oracle (next-hop ties may differ; the fence in
+    #: tests/test_hier.py); with ``mesh_devices`` the pod blocks and
+    #: border rows shard one block-shard per device, and
+    #: ``ring_exchange`` moves the border-distance plane over the
+    #: PR-10 ring. Default OFF: the dense oracle path is
+    #: byte-identical (pinned).
+    hier_oracle: bool = False
+    #: partitioner pod-size target for fabrics without a PodMap
+    #: annotation (0 = ~sqrt(V) auto — balances pod blocks against the
+    #: border skeleton)
+    hier_pod_target: int = 0
     #: rank-pair count at or above which a proactive collective install
     #: uses the array-native block path (int MAC keys, shared
     #: FlowPathBlocks, one event per collective) instead of the
@@ -234,6 +252,18 @@ class Config:
     #: token-bucket burst depth of the admission gate (requests a
     #: quiet tenant may fire back-to-back before rate limiting bites)
     admission_burst: float = 32.0
+    #: weighted fair queueing between BULK tenants in the two-class
+    #: coalescer (ISSUE 13 satellite): tenant name -> weight. When a
+    #: window's latency-sensitive entries leave room for bulk
+    #: (collective-member) lookups, the room is split across the bulk
+    #: tenants PRESENT in the backlog proportionally to their weights
+    #: (unlisted tenants weigh 1.0), each tenant served in its own
+    #: arrival order — one tenant's alltoall storm can no longer
+    #: monopolize every bulk slot of every window. The
+    #: latency-sensitive class is untouched, and the empty default is
+    #: byte-identical to the PR-11 arrival-order bulk fill (pinned by
+    #: tests/test_serving.py).
+    coalesce_wfq_weights: dict = dataclasses.field(default_factory=dict)
     #: persistent JAX compilation cache directory ("" = off): compiled
     #: device programs (APSP, window extraction, the DAG engine) are
     #: written to disk and reloaded by a restarted controller, so the

@@ -765,12 +765,15 @@ class Router:
         sel = [i for i, p in enumerate(pending) if not p.bulk][:cap]
         if len(sel) < cap:
             room = cap - len(sel)
-            bulk_idx = []
-            for i, p in enumerate(pending):
-                if p.bulk:
-                    bulk_idx.append(i)
-                    if len(bulk_idx) == room:
-                        break
+            if self.config.coalesce_wfq_weights:
+                bulk_idx = self._wfq_bulk(pending, room)
+            else:
+                bulk_idx = []
+                for i, p in enumerate(pending):
+                    if p.bulk:
+                        bulk_idx.append(i)
+                        if len(bulk_idx) == room:
+                            break
             sel = sorted(sel + bulk_idx)
         taken = set(sel)
         batch = [pending[i] for i in sel]
@@ -779,6 +782,50 @@ class Router:
         # backlog) on exactly the storm backlog this queue exists for
         pending[:] = [p for i, p in enumerate(pending) if i not in taken]
         return batch
+
+    def _wfq_bulk(self, pending, room: int) -> list[int]:
+        """Weighted fair split of a window's bulk room across tenants
+        (Config.coalesce_wfq_weights, ISSUE 13 satellite): the room is
+        allocated to the bulk tenants PRESENT in the backlog
+        proportionally to their weights (unlisted tenants weigh 1.0)
+        by largest-remainder rounding — deterministic, ties to the
+        lexicographically-first tenant — and each tenant's allocation
+        is served in its own arrival order. A tenant with less backlog
+        than its share donates the surplus to the others, so no slot
+        is wasted; a single-tenant backlog degenerates to the plain
+        arrival-order fill byte-identically (pinned by
+        tests/test_serving.py)."""
+        weights_cfg = self.config.coalesce_wfq_weights
+        groups: dict[str, list[int]] = {}
+        for i, p in enumerate(pending):
+            if p.bulk:
+                groups.setdefault(
+                    self.admission.tenant_of(p.src), []
+                ).append(i)
+        if not groups:
+            return []
+        weights = {
+            t: max(float(weights_cfg.get(t, 1.0)), 1e-9) for t in groups
+        }
+        total_w = sum(weights.values())
+        alloc = {
+            t: min(len(groups[t]), int(room * weights[t] / total_w))
+            for t in groups
+        }
+        used = sum(alloc.values())
+        while used < room:
+            best = None
+            for t in sorted(groups):
+                if alloc[t] >= len(groups[t]):
+                    continue
+                deficit = room * weights[t] / total_w - alloc[t]
+                if best is None or deficit > best[0] + 1e-12:
+                    best = (deficit, t)
+            if best is None:
+                break  # every tenant's backlog exhausted
+            alloc[best[1]] += 1
+            used += 1
+        return [i for t in groups for i in groups[t][: alloc[t]]]
 
     def _dispatch_window(self, pairs, policy: str = "shortest", dirty=None):
         """Dispatch one window through the split-phase oracle API, or
@@ -1896,12 +1943,16 @@ class Router:
         - None: no basis to narrow (first pass, broken/overflowed
           delta log, host/switch membership deltas, the utilization
           plane moved under an unchanged graph, ``Config.delta_reval``
-          off, or the gap contains a link ADD) — full pass. Adds fall
-          back deliberately: a restored cable can shorten flows whose
-          CURRENT detour avoids both of its endpoints entirely (a
-          torus neighbor pair's around-the-ring detour), so endpoint
-          narrowing would strand stale routes and break the
-          narrowed-vs-full bit-identity the escape hatch guarantees.
+          off, or the gap contains a non-narrowable link ADD) — full
+          pass. Adds fall back deliberately: a restored cable can
+          shorten flows whose CURRENT detour avoids both of its
+          endpoints entirely (a torus neighbor pair's around-the-ring
+          detour), so endpoint narrowing would strand stale routes and
+          break the narrowed-vs-full bit-identity the escape hatch
+          guarantees. The ONE exception (ISSUE 13): an add interior to
+          a single pod of a generator-certified PodMap narrows to that
+          pod's member set — the proof lives with
+          ``narrowed_dirty_set`` in core/topology_db.py.
 
         Precedence note: when the graph changed AND the utilization
         plane also moved, the link-delta narrowing still applies — the
@@ -1937,11 +1988,19 @@ class Router:
         deltas = deltas_since(last_v) if deltas_since else None
         if deltas is None:
             return None  # log broken (structural) or overflowed
-        # ONE copy of the delete-narrowing kind rules, shared with the
-        # route cache's invalidation sweep (the proof lives there)
+        # ONE copy of the delta-narrowing kind rules, shared with the
+        # route cache's invalidation sweep (the proofs live there).
+        # The PodMap pair additionally narrows certified intra-pod
+        # link ADDS to the pod's member set (ISSUE 13): an affected
+        # flow necessarily has an endpoint inside the pod, and its
+        # installed path rides that endpoint switch — always narrowed
+        # in, so narrowed == full stays bit-identical.
         from sdnmpi_tpu.core.topology_db import narrowed_dirty_set
 
-        return narrowed_dirty_set(deltas)
+        return narrowed_dirty_set(
+            deltas, getattr(db, "podmap", None),
+            db if hasattr(db, "live_border_set") else None,
+        )
 
     def _revalidate_flows(self) -> None:
         """Recompute installed routes after a topology change; tear down

@@ -68,6 +68,8 @@ class TopologyManager:
             delta_repair_threshold=config.delta_repair_threshold,
             route_cache=config.route_cache,
             route_cache_max_entries=config.route_cache_max_entries,
+            hier_oracle=config.hier_oracle,
+            hier_pod_target=config.hier_pod_target,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed
         #: link in bps: max of the sender's tx stream and the receiver's
@@ -86,7 +88,14 @@ class TopologyManager:
         #: oracle; None on the pure-Python backend (which has no
         #: balancing to feed) or when Config.util_plane is off.
         self.util_plane = None
-        if config.oracle_backend == "jax" and config.util_plane:
+        if (
+            config.oracle_backend == "jax" and config.util_plane
+            and not config.hier_oracle
+            # the device plane IS a dense [V, V] tensor — exactly the
+            # ceiling the hierarchical oracle escapes; under hier the
+            # host dict stays authoritative and the oracle steers
+            # through its pod-aggregated view (oracle/hier.py)
+        ):
             from sdnmpi_tpu.oracle.utilplane import UtilPlane
 
             self.util_plane = UtilPlane(

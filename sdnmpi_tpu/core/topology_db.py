@@ -105,34 +105,119 @@ def _entity_dict(obj: Any) -> Any:
     return out
 
 
-def narrowed_dirty_set(deltas) -> Optional[set]:
-    """The delete-narrowing rule, in ONE place (ISSUE 11 review).
+def narrowed_dirty_set(deltas, podmap=None, db=None) -> Optional[set]:
+    """The delta-narrowing rules, in ONE place (ISSUE 11 review; link
+    adds ISSUE 13).
 
     Given :meth:`TopologyDB.deltas_since` entries, returns the dirtied
-    dpid set when the gap is coverable by pure link *deletes* (each
-    contributes its endpoint dpids; ``switch_upsert`` port-set
-    refreshes never change the routed graph and are ignorable), or
-    None when ANY delta kind defeats narrowing — link adds re-optimize
-    globally (a restored cable can shorten flows whose current detour
-    avoids both endpoints: the torus counterexample), and host /
-    switch membership deltas move endpoint resolution in ways installed
-    hop sets cannot express. Soundness of the delete case: a pair's
-    chosen shortest path changes under a delete only if it rode the
-    deleted link, so its hops contain both endpoints.
+    dpid set when every delta is individually narrowable, or None when
+    ANY delta kind defeats narrowing. The rules:
 
-    Both consumers — the Router's delta-narrowed revalidation
+    - ``link-`` narrows to its endpoint dpids. Soundness: a pair's
+      chosen shortest path changes under a delete only if it rode the
+      deleted link, so its hops contain both endpoints.
+    - ``switch_upsert`` (a port-set refresh of a known dpid) never
+      changes the routed graph and is ignorable.
+    - ``link+`` normally defeats narrowing — a restored cable can
+      shorten flows whose current detour avoids both endpoints (the
+      torus counterexample). EXCEPT (ISSUE 13): when the topology
+      carries a :class:`~sdnmpi_tpu.topogen.podmap.PodMap` whose
+      generator certified ``intra_add_narrows``, BOTH endpoints are
+      *interior* (non-border) switches of ONE pod, AND every live
+      border pair of that pod is currently within in-pod distance 2
+      (:func:`_pod_borders_within_two`), the add narrows to that pod's
+      member set. Soundness, in two steps. (1) An interior add cannot
+      change any border-pair in-pod distance that is currently <= 2:
+      every new path between borders via the added link spends >= 1
+      hop reaching the first interior endpoint and >= 1 hop returning
+      from the second, so it has length >= 3. The <= 2 precondition is
+      checked LIVE — it holds for pristine fat-tree pods (every agg
+      pair meets through every edge switch) and dragonfly groups
+      (complete), exactly the structural facts the generators certify,
+      and it automatically FAILS (falling back to the clear) once
+      intra-pod deletes degrade the pod, where an interior add really
+      can restore a border-to-border transit (e.g. a pod whose two
+      agg-edge diagonals were cut: an edge-edge add revives the
+      agg->agg path at length 3). (2) With every border-to-border
+      transit cost through the pod unchanged, any pair with both
+      endpoints OUTSIDE the pod is unaffected — its shortest distance
+      decomposes at the pod's borders. Any pair a shorter path COULD
+      reach has an endpoint inside the pod, and its installed route
+      necessarily rides its own endpoint switch, a pod member, so the
+      pod-member dirty set always covers it. Border membership is
+      evaluated against the CURRENT link set, which only
+      over-approximates the pre-add borders — over-approximation can
+      only force MORE adds down the clear path, never unsound
+      narrowing. Unannotated fabrics and partitioner-recovered maps
+      (``intra_add_narrows=False``) keep the always-sound clear.
+    - host / switch membership deltas move endpoint resolution in ways
+      installed hop sets cannot express: never narrowable.
+
+    All consumers — the Router's delta-narrowed revalidation
     (control/router.py) and the route cache's invalidation sweep
-    (oracle/routecache.py) — share this helper so the proof cannot
-    drift between them."""
+    (oracle/routecache.py) — share this helper so the proofs cannot
+    drift between them. ``podmap`` is the TopologyDB's annotation (or
+    None) and ``db`` the live TopologyDB — borders and the <= 2
+    precondition are properties of the CURRENT links, not the
+    annotation, and are only computed when a link+ delta actually
+    needs them. Callers that cannot supply both keep the stricter
+    rules."""
     dirty: set = set()
+    members_of: Optional[list] = None
+    borders: Optional[set] = None
     for entry in deltas:
         kind = entry[1]
         if kind == "link-":
             dirty.add(entry[2])
             dirty.add(entry[3])
+        elif (
+            kind == "link+"
+            and podmap is not None
+            and db is not None
+            and getattr(podmap, "intra_add_narrows", False)
+        ):
+            a, b = entry[2], entry[3]
+            pa = podmap.pod_of.get(a)
+            if pa is None or podmap.pod_of.get(b) != pa:
+                return None  # inter-pod or unmapped add: clear
+            if borders is None:
+                borders = db.live_border_set()
+            if a in borders or b in borders:
+                return None  # a border endpoint: no structural cert
+            if members_of is None:
+                members_of = podmap.members()
+            members = members_of[pa]
+            if not _pod_borders_within_two(db, members, borders):
+                return None  # a degraded pod: the cert's premise fell
+            dirty.update(members)
         elif kind != "switch_upsert":
             return None
     return dirty
+
+
+def _pod_borders_within_two(db, members, borders) -> bool:
+    """The live precondition of the intra-pod add narrowing: every
+    ordered pair of the pod's borders is within IN-POD distance 2
+    (direct link, or a shared pod-member relay, checked per direction
+    — the graph discipline is symmetric cables, but staying
+    directed-safe costs nothing). See ``narrowed_dirty_set`` step (1)
+    for why <= 2 is the exact threshold an interior add cannot
+    touch."""
+    pod_set = set(members)
+    bs = sorted(d for d in members if d in borders)
+    out_nb = {
+        x: {n for n in db.links.get(x, ()) if n in pod_set} for x in bs
+    }
+    in_nb = {
+        y: {z for z in pod_set if y in db.links.get(z, ())} for y in bs
+    }
+    for x in bs:
+        for y in bs:
+            if x == y or y in out_nb[x]:
+                continue
+            if out_nb[x].isdisjoint(in_nb[y]):
+                return False
+    return True
 
 
 #: delta-log depth: enough to cover any burst the oracle would repair
@@ -154,6 +239,8 @@ class TopologyDB:
         delta_repair_threshold: Optional[int] = None,
         route_cache: bool = False,
         route_cache_max_entries: int = 4096,
+        hier_oracle: bool = False,
+        hier_pod_target: int = 0,
     ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
@@ -175,6 +262,22 @@ class TopologyDB:
         #: sharded legs (Config.ring_exchange, ISSUE 10); needs
         #: shard_oracle, bit-identical routes either way
         self.ring_exchange = ring_exchange
+        #: hierarchical two-level oracle (Config.hier_oracle, ISSUE 13,
+        #: oracle/hier.py): dense per-pod blocks + a compressed border
+        #: skeleton replace the dense [V, V] planes — O(pods x
+        #: pod_size^2) memory, datacenter-scale fabrics on one slice.
+        #: False keeps the dense oracle byte-identical. Only meaningful
+        #: with the jax backend (the py backend is already host BFS).
+        self.hier_oracle = hier_oracle
+        #: partitioner pod-size target when the topology carries no
+        #: PodMap annotation (0 = ~sqrt(V) auto)
+        self.hier_pod_target = hier_pod_target
+        #: pod structure annotation (topogen/podmap.py): set by
+        #: TopoSpec.to_topology_db for generator fabrics, None for
+        #: discovered/hand-built graphs (the hier oracle partitions
+        #: those itself; the route cache's narrowed link-add
+        #: invalidation simply stays off without one)
+        self.podmap = None
         #: max link deltas the oracle absorbs by in-place repair before
         #: a full recompute (None = RouteOracle's default; 0 disables)
         self.delta_repair_threshold = delta_repair_threshold
@@ -260,6 +363,24 @@ class TopologyDB:
     def version(self) -> int:
         """Bumped on every mutation; oracle caches are keyed on this."""
         return self._version
+
+    def live_border_set(self) -> set:
+        """Dpids with at least one link whose far end lives in another
+        pod of :attr:`podmap` (or outside it) — the LIVE border set the
+        narrowed link-add invalidation checks interiors against
+        (:func:`narrowed_dirty_set`). Empty without an annotation."""
+        podmap = self.podmap
+        if podmap is None:
+            return set()
+        pod_of = podmap.pod_of
+        borders: set = set()
+        for src, dst_map in self.links.items():
+            ps = pod_of.get(src)
+            for dst in dst_map:
+                if pod_of.get(dst) != ps or ps is None:
+                    borders.add(src)
+                    borders.add(dst)
+        return borders
 
     def deltas_since(self, version: int) -> Optional[list[tuple]]:
         """Every mutation after ``version``, as ``(version, kind, ...)``
@@ -752,14 +873,29 @@ class TopologyDB:
 
     def _jax_oracle(self):
         if self._oracle is None:
-            from sdnmpi_tpu.oracle.engine import RouteOracle
+            if self.hier_oracle:
+                # the hierarchical two-level oracle (ISSUE 13) answers
+                # the same seams through pod blocks + the border
+                # skeleton; hier_oracle=False keeps this branch cold
+                # and the dense path byte-identical
+                from sdnmpi_tpu.oracle.hier import HierOracle
 
-            self._oracle = RouteOracle(
-                self.pad_multiple, self.max_diameter,
-                mesh_devices=self.mesh_devices,
-                shard_oracle=self.shard_oracle,
-                ring_exchange=self.ring_exchange,
-            )
+                self._oracle = HierOracle(
+                    self.pad_multiple, self.max_diameter,
+                    mesh_devices=self.mesh_devices,
+                    shard_oracle=self.shard_oracle,
+                    ring_exchange=self.ring_exchange,
+                    pod_target=self.hier_pod_target,
+                )
+            else:
+                from sdnmpi_tpu.oracle.engine import RouteOracle
+
+                self._oracle = RouteOracle(
+                    self.pad_multiple, self.max_diameter,
+                    mesh_devices=self.mesh_devices,
+                    shard_oracle=self.shard_oracle,
+                    ring_exchange=self.ring_exchange,
+                )
             if self.delta_repair_threshold is not None:
                 self._oracle.delta_repair_threshold = (
                     self.delta_repair_threshold

@@ -162,6 +162,8 @@ def config_from_args(args) -> Config:
         mesh_devices=args.mesh_devices,
         shard_oracle=getattr(args, "shard_oracle", False),
         ring_exchange=getattr(args, "ring_exchange", False),
+        hier_oracle=getattr(args, "hier_oracle", False),
+        hier_pod_target=getattr(args, "hier_pod_target", 0),
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
@@ -509,6 +511,22 @@ def build_parser() -> argparse.ArgumentParser:
         "(the PR-9 default; byte-identical differential escape hatch)",
     )
     parser.set_defaults(ring_exchange=False)
+    parser.add_argument(
+        "--hier-oracle", action="store_true",
+        help="route through the hierarchical two-level oracle "
+        "(oracle/hier.py): dense per-pod blocks + a compressed border "
+        "skeleton replace every dense [V, V] plane — O(pods x "
+        "pod_size^2) memory, 65k-switch fabrics on one slice. Path "
+        "lengths bit-identical to the dense oracle; with "
+        "--mesh-devices the pod blocks shard one block-shard per "
+        "device and --ring-exchange moves the border plane over the "
+        "ring",
+    )
+    parser.add_argument(
+        "--hier-pod-target", type=int, default=0,
+        help="partitioner pod-size target for unannotated fabrics "
+        "under --hier-oracle (0 = ~sqrt(V) auto)",
+    )
     parser.add_argument(
         "--distributed", metavar="HOST:PORT,NPROC,RANK",
         help="join a multi-host shardplane mesh: initialize "

@@ -52,6 +52,10 @@ import numpy as np
 from sdnmpi_tpu.utils.metrics import REGISTRY
 from sdnmpi_tpu.utils.tracing import start_child_span
 
+#: format version of the serialized memo (api/snapshot rides it beside
+#: the compile cache); restores refuse any other value
+ROUTE_CACHE_SNAPSHOT_VERSION = 1
+
 _m_hits = REGISTRY.counter(
     "route_cache_hits_total",
     "route window / collective requests served from the memo cache "
@@ -133,12 +137,18 @@ class RouteCache:
             # log does not cover the gap): correctness over reuse
             self._clear()
             return
-        # the ONE copy of the delete-narrowing kind rules (shared with
+        # the ONE copy of the delta-narrowing kind rules (shared with
         # the Router's delta-narrowed revalidation — see its docstring
-        # for the soundness proof): None = some delta defeats narrowing
+        # for the soundness proofs): None = some delta defeats
+        # narrowing. The PodMap + live-border pair arms the ISSUE-13
+        # intra-pod link-ADD narrowing (an interior add evicts only
+        # that pod's riders); without an annotation, adds clear.
         from sdnmpi_tpu.core.topology_db import narrowed_dirty_set
 
-        dirty = narrowed_dirty_set(deltas)
+        dirty = narrowed_dirty_set(
+            deltas, getattr(db, "podmap", None),
+            db if hasattr(db, "live_border_set") else None,
+        )
         if dirty is None:
             self._clear()
             return
@@ -259,6 +269,160 @@ class RouteCache:
             _m_evictions.inc()
         _m_entries.set(len(self._lru))
         return result
+
+    # -- restart persistence (ISSUE 13 satellite) --------------------------
+
+    @staticmethod
+    def topology_digest(db) -> str:
+        """Canonical digest of the routed graph (sorted switches,
+        directed links with ports, host attachments) — the restore
+        guard: a memo snapshot only applies to the EXACT fabric it was
+        taken on. Sorted forms, so dict insertion order (which differs
+        between a discovered and a restored controller) cannot flip
+        the digest."""
+        h = hashlib.blake2b(digest_size=16)
+        for dpid in sorted(db.switches):
+            h.update(b"s%d" % dpid)
+        links = sorted(
+            (src, dst, link.src.port_no)
+            for src, dst_map in db.links.items()
+            for dst, link in dst_map.items()
+        )
+        for src, dst, port in links:
+            h.update(b"l%d>%d:%d" % (src, dst, port))
+        for mac in sorted(db.hosts):
+            host = db.hosts[mac]
+            h.update(
+                f"h{mac}@{host.port.dpid}:{host.port.port_no}".encode()
+            )
+        return h.hexdigest()
+
+    def snapshot_entries(self, db) -> dict:
+        """Serializable form of the SURVIVING entries — the shortest-
+        policy memo only. Utilization-keyed entries (balanced /
+        adaptive / collective with a live epoch) are deliberately
+        dropped: UtilPlane epochs restart from zero, so a restored
+        epoch-N key would collide with a fresh epoch N carrying
+        different measured loads and break hit == miss. Version-
+        guarded (format + topology digest) on restore."""
+        from sdnmpi_tpu.oracle.batch import WindowRoutes
+
+        # settle pending deltas FIRST: the digest below describes the
+        # CURRENT graph, so serializing entries still awaiting
+        # invalidation would stamp stale routes with a digest a
+        # restarted controller legitimately matches (review
+        # regression: a deleted link's rider served as a post-restore
+        # hit)
+        self.sync(db)
+        entries = []
+        for key, e in self._lru.items():
+            if e.util_keyed:
+                continue
+            r = e.result
+            if isinstance(r, WindowRoutes):
+                if r.touched is not None:
+                    continue  # delta-narrowed windows are churn-local
+                payload = {
+                    "kind": "window",
+                    "hop_dpid": r.hop_dpid.tolist(),
+                    "hop_port": r.hop_port.tolist(),
+                    "hop_len": r.hop_len.tolist(),
+                    "max_congestion": float(r.max_congestion),
+                    "n_detours": int(r.n_detours),
+                }
+            else:  # CollectiveRoutes
+                payload = {
+                    "kind": "collective",
+                    "pair_sub": r.pair_sub.tolist(),
+                    "final_port": r.final_port.tolist(),
+                    "hop_dpid": r.hop_dpid.tolist(),
+                    "hop_port": r.hop_port.tolist(),
+                    "hop_len": r.hop_len.tolist(),
+                    "max_congestion": float(r.max_congestion),
+                    "n_detours": int(r.n_detours),
+                    "endpoint_port": (
+                        None if r.endpoint_port is None
+                        else r.endpoint_port.tolist()
+                    ),
+                }
+            entries.append({
+                "key": [
+                    p.hex() if isinstance(p, bytes) else p for p in key
+                ],
+                "key_bytes": [
+                    i for i, p in enumerate(key) if isinstance(p, bytes)
+                ],
+                "riders": sorted(e.riders),
+                "result": payload,
+            })
+        return {
+            "version": ROUTE_CACHE_SNAPSHOT_VERSION,
+            "topology_digest": self.topology_digest(db),
+            "entries": entries,
+        }
+
+    def restore_entries(self, snapshot: dict, db) -> int:
+        """Re-seed the memo from :meth:`snapshot_entries` output.
+        Returns the number of entries restored; 0 — never an error —
+        when the format version or the topology digest does not match
+        the LIVE fabric (a restarted controller that discovered a
+        different network must not serve the old one's routes)."""
+        from sdnmpi_tpu.oracle.batch import CollectiveRoutes, WindowRoutes
+
+        # entries already LIVE in this cache may have pending un-synced
+        # deltas (restore_controller itself mutates the db — host adds
+        # — right before calling here); settle them through the normal
+        # invalidation sweep FIRST, before any guard can return and
+        # before the restore rebases the version — or their eviction
+        # would silently be skipped
+        self.sync(db)
+        if snapshot.get("version") != ROUTE_CACHE_SNAPSHOT_VERSION:
+            return 0
+        if snapshot.get("topology_digest") != self.topology_digest(db):
+            return 0
+        restored = 0
+        for item in snapshot.get("entries", []):
+            byte_slots = set(item.get("key_bytes", []))
+            key = tuple(
+                bytes.fromhex(p) if i in byte_slots else
+                (tuple(p) if isinstance(p, list) else p)
+                for i, p in enumerate(item["key"])
+            )
+            payload = item["result"]
+            hop_dpid = np.asarray(payload["hop_dpid"], np.int64)
+            hop_port = np.asarray(payload["hop_port"], np.int32)
+            hop_len = np.asarray(payload["hop_len"], np.int32)
+            if payload["kind"] == "window":
+                result: Any = WindowRoutes(
+                    hop_dpid, hop_port, hop_len,
+                    max_congestion=payload["max_congestion"],
+                    n_detours=payload["n_detours"],
+                )
+            else:
+                ep = payload.get("endpoint_port")
+                result = CollectiveRoutes(
+                    np.asarray(payload["pair_sub"], np.int32),
+                    np.asarray(payload["final_port"], np.int32),
+                    hop_dpid, hop_port, hop_len,
+                    max_congestion=payload["max_congestion"],
+                    n_detours=payload["n_detours"],
+                    endpoint_port=(
+                        None if ep is None else np.asarray(ep, np.int32)
+                    ),
+                )
+            self._lru[key] = _Entry(
+                result, frozenset(item.get("riders", [])), False
+            )
+            self._lru.move_to_end(key)
+            restored += 1
+        while len(self._lru) > self.max_entries:
+            self._lru.popitem(last=False)
+        if restored:
+            _m_entries.set(len(self._lru))
+            # baseline the delta sync at the live version: the digest
+            # match proves the graph is the snapshot's graph
+            self._version = db.version
+        return restored
 
     def store_window(self, key: tuple, window, version: int):
         """Wrap a dispatched :class:`RouteWindow` so its reap lands in

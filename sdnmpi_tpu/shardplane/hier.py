@@ -1,0 +1,328 @@
+"""Shardplane executors of the hierarchical oracle (ISSUE 13).
+
+The two-level oracle's device story: the mesh holds **one pod-block
+shard per device** — the stacked ``[nP, S, S]`` intra-pod tensors and
+the lazy border-distance row planes partition over the pod/row axis, so
+oracle capacity grows linearly with chips (O(pods * pod_size^2) total,
+O(pods * pod_size^2 / devices) per device) where the dense oracle's
+``[V, V]`` plane is a per-device wall. Three executors:
+
+- :func:`pod_stack_apsp` — level 1: BFS distances + masked-argmin next
+  hops for a whole pod-size bucket in ONE vmapped program (batched
+  matmuls — the same frontier-expansion idiom as oracle/apsp.py),
+  ``shard_map``-partitioned over the pod axis when a mesh exists; each
+  device's pods converge independently, no collectives.
+- :func:`sweep_rows_sharded` — level 2: the border-skeleton pull-sweeps
+  (the exact algorithm of ``oracle.hier.sweep_rows_host``, pinned
+  equal by differential test) with the row axis sharded over the mesh;
+  rows are embarrassingly parallel, so again no collectives.
+- :func:`ring_exchange_border_plane` — the (small) per-pod
+  border-distance plane, replicated from the pod-sharded block stacks
+  over the PR-10 bidirectional ring (kernels/ring.py, bf16/int16 wire)
+  instead of any full gather — the level-2 builder consumes the
+  exchanged bytes directly, and a bit-identity fence pins them to the
+  host slice (tests/test_hier.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+
+from sdnmpi_tpu.shardplane.mesh import P, mesh_axes, mesh_shards, shard_map
+
+#: row-chunk of the sweep executors: bounds the gathered [rows, nB, K]
+#: relaxation intermediates on device
+_SWEEP_ROW_CHUNK = 32
+
+
+def _col_chunk(n: int, s: int) -> int:
+    """Largest divisor of ``s`` keeping the next-hop argmin broadcast
+    ([nP, s, s, cb]) under ~64M floats."""
+    cb = s
+    while cb > 1 and n * s * s * cb > (1 << 26):
+        nxt = cb - 1
+        while nxt > 1 and s % nxt:
+            nxt -= 1
+        cb = nxt
+    return max(1, cb)
+
+
+def _stack_apsp_core(adj, cb: int):
+    """Distances + next hops for a stacked [nP, s, s] pod bucket.
+
+    BFS frontier expansion as batched f32 matmuls (one [nP, s, s] @
+    [nP, s, s] per hop, clamped to {0, 1}), then the dense masked
+    argmin per destination-column chunk — the lowest-index tie-break
+    matches the dense oracle's sorted-order determinism, though the
+    hier fence only relies on lengths."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("hier_pod_apsp")
+    n, s, _ = adj.shape
+    a = (adj > 0).astype(jnp.float32)
+    eye = jnp.eye(s, dtype=jnp.float32)
+    reached0 = jnp.broadcast_to(eye, (n, s, s))
+    dist0 = jnp.where(reached0 > 0, 0.0, jnp.inf)
+
+    def cond(carry):
+        _, _, t, changed = carry
+        return changed & (t <= s)
+
+    def body(carry):
+        reached, dist, t, _ = carry
+        grown = jnp.minimum(jnp.matmul(reached, a) + reached, 1.0)
+        newly = (grown > 0) & jnp.isinf(dist)
+        dist = jnp.where(newly, t.astype(jnp.float32), dist)
+        return grown, dist, t + 1, jnp.any(newly)
+
+    _, dist, _, _ = lax.while_loop(
+        cond, body, (reached0, dist0, jnp.int32(1), jnp.bool_(True))
+    )
+
+    adj_mask = a > 0
+
+    def per(dist_cols):  # [n, s, cb] distances to cb destinations
+        scores = jnp.where(
+            adj_mask[:, :, :, None], dist_cols[:, None, :, :], jnp.inf
+        )
+        return jnp.argmin(scores, axis=2).astype(jnp.int32)
+
+    if cb == s:
+        nxt = per(dist)
+    else:
+        chunks = jnp.moveaxis(dist.reshape(n, s, s // cb, cb), 2, 0)
+        nxt = jnp.moveaxis(lax.map(per, chunks), 0, 2).reshape(n, s, s)
+    idx = jnp.arange(s, dtype=jnp.int32)
+    nxt = jnp.where(jnp.isinf(dist), -1, nxt)
+    nxt = jnp.where(idx[:, None] == idx[None, :], idx[:, None], nxt)
+    return dist, nxt
+
+
+@functools.partial(jax.jit, static_argnames=("cb",))
+def _stack_apsp_jit(adj, cb: int):
+    return _stack_apsp_core(adj, cb)
+
+
+@functools.lru_cache(maxsize=None)
+def _stack_apsp_sharded_fn(mesh, cb: int):
+    axes = mesh_axes(mesh)
+    fn = shard_map(
+        lambda a: _stack_apsp_core(a, cb),
+        mesh,
+        in_specs=P(axes),
+        out_specs=(P(axes), P(axes)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def pod_stack_apsp(adj, mesh=None):
+    """(dist [nP, s, s] f32, next [nP, s, s] int32) for a stacked pod
+    bucket, as host arrays. With a mesh and enough pods the stack
+    partitions over every device (pods converge independently —
+    shard_map with no collectives); otherwise one vmapped program."""
+    adj = np.ascontiguousarray(adj, np.float32)
+    n, s, _ = adj.shape
+    if n == 0:
+        return (
+            np.zeros((0, s, s), np.float32), np.zeros((0, s, s), np.int32)
+        )
+    if mesh is not None:
+        shards = mesh_shards(mesh)
+        if shards > 1 and n >= shards:
+            pad = (-n) % shards
+            if pad:
+                adj = np.concatenate(
+                    [adj, np.zeros((pad, s, s), np.float32)]
+                )
+            cb = _col_chunk(adj.shape[0] // shards, s)
+            dist, nxt = _stack_apsp_sharded_fn(mesh, cb)(adj)
+            return np.asarray(dist)[:n], np.asarray(nxt)[:n]
+    cb = _col_chunk(n, s)
+    dist, nxt = _stack_apsp_jit(jnp.asarray(adj), cb)
+    return np.asarray(dist), np.asarray(nxt)
+
+
+def shard_pod_stack(arr: np.ndarray, mesh):
+    """Device-resident twin of a pod-stacked array, partitioned over
+    the mesh's combined axis (pod-axis padding to the shard count —
+    the 'one pod block shard per device' residency the bench's
+    peak-device-memory column accounts)."""
+    shards = mesh_shards(mesh)
+    pad = (-arr.shape[0]) % shards
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad, *arr.shape[1:]), arr.dtype)]
+        )
+    return jax.device_put(
+        arr, NamedSharding(mesh, P(mesh_axes(mesh)))
+    )
+
+
+# -- level 2: sharded border-row sweeps -----------------------------------
+
+
+def _sweep_core(tloc, flat, shapes, n_borders: int, rc: int):
+    """Bucketed Jacobi pull-sweeps for a block of target rows (the
+    shard_map body) — the SAME schedule as the host executor
+    (oracle.hier.sweep_rows_host: every bucket gathers from the
+    previous sweep's rows, scatter-min into the new ones), so the two
+    are bit-identical. ``tloc`` [tl] border ids (-1 pads allowed:
+    their rows are discarded by the caller and touch no other row).
+    ``flat`` is the flattened (ids, cand, w) bucket arrays."""
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("hier_row_sweep")
+    buckets = [
+        (flat[3 * i], flat[3 * i + 1], flat[3 * i + 2])
+        for i in range(len(shapes))
+    ]
+    tl = tloc.shape[0]
+    r0 = jnp.full((tl, n_borders), jnp.inf, jnp.float32)
+    r0 = r0.at[jnp.arange(tl), jnp.maximum(tloc, 0)].set(
+        jnp.where(tloc >= 0, 0.0, jnp.inf)
+    )
+
+    def chunk_fn(rows):  # [rc, B]
+        def sweep_cond(c):
+            return c[1]
+
+        def sweep_body(c):
+            r, _ = c
+            rn = r
+            for ids, cand, w in buckets:
+                nb, k = cand.shape
+                vals = r[:, cand.reshape(-1)].reshape(rc, nb, k) + w
+                rn = rn.at[:, ids].min(vals.min(axis=2))
+            return rn, jnp.any(rn < r)
+
+        out, _ = lax.while_loop(
+            sweep_cond, sweep_body, (rows, jnp.bool_(True))
+        )
+        return out
+
+    return lax.map(
+        chunk_fn, r0.reshape(tl // rc, rc, n_borders)
+    ).reshape(tl, n_borders)
+
+
+@functools.lru_cache(maxsize=None)
+def _sweep_sharded_fn(mesh, shapes, n_borders: int, rc: int):
+    axes = mesh_axes(mesh)
+    fn = shard_map(
+        lambda t, *flat: _sweep_core(t, flat, shapes, n_borders, rc),
+        mesh,
+        in_specs=(P(axes),) + tuple(P() for _ in range(3 * len(shapes))),
+        out_specs=P(axes),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _sweep_jit_fn(shapes, n_borders: int, rc: int):
+    return jax.jit(
+        lambda t, *flat: _sweep_core(t, flat, shapes, n_borders, rc)
+    )
+
+
+def sweep_rows_sharded(deg_buckets, n_borders, targets, mesh):
+    """Border-distance rows (see ``oracle.hier.sweep_rows_host`` — the
+    bit-identical host twin) with the row axis sharded over the mesh.
+    Returns (host rows [T, B] f32, the device-resident sharded plane
+    the bench's memory column accounts — padding rows included).
+
+    Per-chunk convergence note: the host executor iterates each row
+    chunk to ITS fixpoint independently, and rows are independent, so
+    chunk-local while_loops (here per device, per chunk) land on the
+    identical fixpoint."""
+    t = len(targets)
+    if t == 0 or n_borders == 0:
+        return np.zeros((t, n_borders), np.float32), None
+    shards = mesh_shards(mesh)
+    quantum = max(1, shards) * _SWEEP_ROW_CHUNK
+    pad = (-t) % quantum
+    tloc = np.concatenate(
+        [np.asarray(targets, np.int32), np.full(pad, -1, np.int32)]
+    )
+    flat = []
+    shapes = []
+    for ids, cand, w in deg_buckets:
+        flat.extend(
+            (jnp.asarray(ids), jnp.asarray(cand), jnp.asarray(w))
+        )
+        shapes.append(cand.shape)
+    shapes = tuple(shapes)
+    if shards > 1:
+        fn = _sweep_sharded_fn(
+            mesh, shapes, int(n_borders), _SWEEP_ROW_CHUNK
+        )
+    else:
+        fn = _sweep_jit_fn(shapes, int(n_borders), _SWEEP_ROW_CHUNK)
+    rows_d = fn(tloc, *flat)
+    return np.asarray(rows_d)[:t], rows_d
+
+
+# -- the ring-exchanged border-distance plane -----------------------------
+
+
+def ring_exchange_border_plane(state) -> dict[int, np.ndarray]:
+    """Replicate each bucket's per-pod border-distance plane (the
+    [nP, bmax, s] border->member slices of the pod-sharded distance
+    stacks) over the PR-10 bidirectional ring — bf16/int16 wire packing
+    included (hop counts are bounded by the pod size, so the packed
+    wire is bit-exact) — instead of any full gather. The level-2
+    builder consumes exactly these bytes for its intra-pod skeleton
+    weights; ``tests/test_hier.py`` fences them against the direct
+    host slice."""
+    from sdnmpi_tpu.kernels.ring import (
+        pack_dist_wire,
+        ring_all_gather,
+        unpack_dist_wire,
+    )
+
+    mesh = state.mesh
+    out: dict[int, np.ndarray] = {}
+    for bi, b in enumerate(state.buckets):
+        nP = len(b.pods)
+        counts = (
+            state.pod_bstart[b.pods + 1] - state.pod_bstart[b.pods]
+        ).astype(np.int64)
+        bmax = int(counts.max(initial=0))
+        if bmax == 0:
+            out[bi] = np.full((nP, 0, b.s), np.inf, np.float32)
+            continue
+        bl = np.zeros((nP, bmax), np.int32)
+        for i, p in enumerate(b.pods):
+            lo = int(state.pod_bstart[p])
+            c = int(counts[i])
+            bl[i, :c] = state.border_local[lo:lo + c]
+        src = b.dist_d if b.dist_d is not None else jnp.asarray(b.dist)
+        pl = src[jnp.arange(nP)[:, None], jnp.asarray(bl), :]
+        wire = pack_dist_wire(pl.reshape(nP, bmax * b.s), v=b.s)
+        rep = ring_all_gather(wire, mesh)
+        plane = np.array(  # owned: the pad-slot masking below writes
+            unpack_dist_wire(rep)
+        ).reshape(nP, bmax, b.s)
+        # pad slots (clamped to border 0 at gather time) -> inf so no
+        # consumer can mistake them for real border rows
+        plane[np.arange(bmax)[None, :] >= counts[:, None]] = np.inf
+        out[bi] = plane
+    return out
+
+
+def hier_device_bytes(state, mesh=None) -> int:
+    """Peak per-device bytes of the hierarchy's device-resident
+    serving tensors: the pod-axis/row-axis shards split evenly, so
+    per-device is total over the shard count."""
+    total = state.device_bytes()
+    if mesh is None:
+        return total
+    return -(-total // mesh_shards(mesh))

@@ -3,3 +3,10 @@ from sdnmpi_tpu.topogen.basic import linear, ring, torus2d, random_regular  # no
 from sdnmpi_tpu.topogen.fattree import fattree  # noqa: F401
 from sdnmpi_tpu.topogen.dragonfly import dragonfly  # noqa: F401
 from sdnmpi_tpu.topogen.torus import torus  # noqa: F401
+from sdnmpi_tpu.topogen.podmap import (  # noqa: F401
+    PodMap,
+    border_sets,
+    inter_pod_links,
+    partition_pods,
+    podmap_for_db,
+)

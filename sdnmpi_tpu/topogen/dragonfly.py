@@ -10,6 +10,7 @@ bound h is respected.
 
 from __future__ import annotations
 
+from sdnmpi_tpu.topogen.podmap import PodMap
 from sdnmpi_tpu.topogen.spec import PortAllocator, TopoSpec, host_mac
 
 
@@ -61,4 +62,16 @@ def dragonfly(
                 rx, ry = next_router(x), next_router(y)
                 links.append((rx, ports.take(rx), ry, ports.take(ry)))
 
-    return TopoSpec(f"dragonfly-g{g}a{a}h{h}", switches, links, hosts)
+    name = f"dragonfly-g{g}a{a}h{h}"
+    # pods = groups (the canonical dragonfly hierarchy); routers with
+    # global-link endpoints are the borders. A group is a complete
+    # graph — every router pair already at distance 1 — so an interior
+    # link add can never change border-to-border distances:
+    # intra_add_narrows is certified True (see topogen/podmap.py).
+    return TopoSpec(
+        name, switches, links, hosts,
+        podmap=PodMap(
+            pod_of={dpid(x, r): x for x in range(g) for r in range(a)},
+            n_pods=g, intra_add_narrows=True, name=name,
+        ),
+    )

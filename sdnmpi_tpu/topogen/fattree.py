@@ -5,19 +5,40 @@ switches; (k/2)^2 core switches; every edge switch serves k/2 hosts.
 Totals: 5k^2/4 switches, k^3/4 hosts, full bisection bandwidth.
 k=16 -> 320 switches / 1024 hosts; k=28 -> 980 switches / 5488 hosts
 (the "1024-switch fat-tree" bench config, padded to 1024 in the oracle).
+
+``pods`` stretches the Clos past the port-count identity: the pod count
+decouples from k, so ``fattree(64, pods=1008)`` is the 65,536-switch /
+~million-host datacenter shape the hierarchical oracle benchmark routes
+(ISSUE 13) — each agg still uplinks to its k/2-core group, the groups
+are just shared by more pods (a legal folded Clos with thinner
+per-pod core bandwidth, exactly how real deployments oversubscribe).
+
+Every fat-tree emits its :class:`~sdnmpi_tpu.topogen.podmap.PodMap`
+natively: pod ``i`` holds pod i's aggs+edges, the core layer is one
+extra pod. Aggs are each pod's borders; the pod interior is the
+edge<->agg bipartite graph, where any two aggs are already at distance
+2 through every edge switch — an interior link add can only offer
+longer detours, so ``intra_add_narrows`` is certified True (the route
+cache's narrowed link-add invalidation rides on it, ISSUE 13
+satellite).
 """
 
 from __future__ import annotations
 
+from sdnmpi_tpu.topogen.podmap import PodMap
 from sdnmpi_tpu.topogen.spec import PortAllocator, TopoSpec, host_mac
 
 
-def fattree(k: int, hosts_per_edge: int | None = None) -> TopoSpec:
+def fattree(
+    k: int, hosts_per_edge: int | None = None, pods: int | None = None
+) -> TopoSpec:
     if k % 2:
         raise ValueError("fat-tree arity k must be even")
     half = k // 2
     if hosts_per_edge is None:
         hosts_per_edge = half
+    if pods is None:
+        pods = k
 
     # dpid layout: cores first, then per pod: aggs, then edges
     n_core = half * half
@@ -30,16 +51,21 @@ def fattree(k: int, hosts_per_edge: int | None = None) -> TopoSpec:
         return 1 + n_core + pod * k + half + e
 
     switches = list(core)
-    for pod in range(k):
+    pod_of: dict[int, int] = {c: pods for c in core}  # core layer: last pod
+    for pod in range(pods):
         switches.extend(agg(pod, a) for a in range(half))
         switches.extend(edge(pod, e) for e in range(half))
+        for a in range(half):
+            pod_of[agg(pod, a)] = pod
+        for e in range(half):
+            pod_of[edge(pod, e)] = pod
 
     ports = PortAllocator()
     links = []
     hosts = []
     host_id = 0
 
-    for pod in range(k):
+    for pod in range(pods):
         for e in range(half):
             e_dpid = edge(pod, e)
             # hosts first so host ports are the low numbers
@@ -57,4 +83,11 @@ def fattree(k: int, hosts_per_edge: int | None = None) -> TopoSpec:
                 c_dpid = core[a * half + j]
                 links.append((a_dpid, ports.take(a_dpid), c_dpid, ports.take(c_dpid)))
 
-    return TopoSpec(f"fattree-k{k}", switches, links, hosts)
+    name = f"fattree-k{k}" if pods == k else f"fattree-k{k}p{pods}"
+    return TopoSpec(
+        name, switches, links, hosts,
+        podmap=PodMap(
+            pod_of=pod_of, n_pods=pods + 1, intra_add_narrows=True,
+            name=name,
+        ),
+    )

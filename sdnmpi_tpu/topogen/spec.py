@@ -33,6 +33,12 @@ class TopoSpec:
     links: list[tuple[int, int, int, int]]
     #: (mac, dpid, port_no)
     hosts: list[tuple[str, int, int]]
+    #: pod structure annotation (topogen/podmap.py, ISSUE 13): emitted
+    #: natively by the fattree/dragonfly generators; None means the
+    #: hierarchical oracle (when selected) recovers one through the
+    #: partitioner fallback. Carried onto the TopologyDB by
+    #: :meth:`to_topology_db`.
+    podmap: "object | None" = None
 
     @property
     def n_switches(self) -> int:
@@ -44,6 +50,7 @@ class TopoSpec:
 
     def to_topology_db(self, **db_kwargs) -> TopologyDB:
         db = TopologyDB(**db_kwargs)
+        db.podmap = self.podmap
         for dpid in self.switches:
             db.add_switch(Switch.make(dpid))
         for a, pa, b, pb in self.links:

@@ -1,0 +1,539 @@
+"""Hierarchical two-level oracle fences (ISSUE 13).
+
+The contract: with ``hier_oracle`` ON, path LENGTHS are bit-identical
+to the dense oracle on every fence topology (next-hop ties may differ;
+validity + length equality are the fence), sim + wire, across a seeded
+churn replay through the delta log; with it OFF the dense path is
+byte-identical (the default-off pin). The sharded/ring executors must
+match the single-device hierarchy exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sdnmpi_tpu.topogen import dragonfly, fattree, linear, torus
+
+from tests.conftest import N_VIRTUAL_DEVICES
+
+TOPOS = {
+    "linear8": lambda: linear(8),
+    "fattree4": lambda: fattree(4),
+    "fattree4p6": lambda: fattree(4, pods=6),
+    "torus3x3": lambda: torus((3, 3)),
+    "dragonfly": lambda: dragonfly(3, 4, 1, 2),
+}
+
+
+def _hosts_pairs(db, n=10):
+    hosts = sorted(db.hosts)[:n]
+    return [(a, b) for a in hosts for b in hosts if a != b]
+
+
+def _assert_valid(db, fdb, dst_mac):
+    """A routed fdb must follow real links with the real ports and end
+    at the destination's attachment."""
+    for (a, pa), (b, _) in zip(fdb, fdb[1:]):
+        link = db.links.get(a, {}).get(b)
+        assert link is not None and link.src.port_no == pa
+    host = db.hosts[dst_mac]
+    assert fdb[-1] == (host.port.dpid, host.port.port_no)
+
+
+# -- the length fence ------------------------------------------------------
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_hier_lengths_match_dense(topo):
+    spec = TOPOS[topo]()
+    dense = spec.to_topology_db(backend="jax")
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(dense)
+    fd = dense.find_routes_batch(pairs)
+    fh = hier.find_routes_batch(pairs)
+    assert [len(x) for x in fd] == [len(y) for y in fh]
+    for (src, dst), fdb in zip(pairs, fh):
+        if fdb:
+            _assert_valid(hier, fdb, dst)
+
+
+def test_hier_unreachable_and_trivial_pairs():
+    """Cut one pod's only uplinks: cross-pod pairs into it go
+    unroutable in BOTH oracles; same-switch pairs stay one-hop."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(4)
+    dense = spec.to_topology_db(backend="jax")
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    # sever pod 0 entirely: delete every agg<->core cable of pod 0
+    core = set(range(1, 5))
+    for a, pa, b, pb in spec.links:
+        if b in core and spec.podmap.pod_of[a] == 0:
+            for db in (dense, hier):
+                db.delete_link(Link(Port(a, pa), Port(b, pb)))
+                db.delete_link(Link(Port(b, pb), Port(a, pa)))
+    pairs = _hosts_pairs(dense, n=8)
+    fd = dense.find_routes_batch(pairs)
+    fh = hier.find_routes_batch(pairs)
+    assert [len(x) for x in fd] == [len(y) for y in fh]
+    assert any(len(x) == 0 for x in fd), "expected severed pairs"
+    # same-switch pair: both hosts on one edge switch
+    by_edge: dict[int, list[str]] = {}
+    for mac, h in dense.hosts.items():
+        by_edge.setdefault(h.port.dpid, []).append(mac)
+    a, b = sorted(next(v for v in by_edge.values() if len(v) >= 2))[:2]
+    assert len(hier.find_route(a, b)) == len(dense.find_route(a, b)) == 1
+
+
+def test_hier_churn_replay_through_delta_log():
+    """Seeded delete/re-add churn: lengths stay fenced every step, and
+    the classifier repairs in place — intra-pod deltas recompute one
+    block, inter-pod deltas only level 2, never a full rebuild."""
+    import random
+
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    for mk in (TOPOS["fattree4"], TOPOS["torus3x3"]):
+        spec = mk()
+        dense = spec.to_topology_db(backend="jax")
+        hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+        pairs = _hosts_pairs(dense, n=6)
+        rng = random.Random(13)
+        cables = list(spec.links)
+        removed = []
+        hier.find_routes_batch(pairs)  # build at version 0
+        oracle = hier._jax_oracle()
+        builds0 = oracle.full_refresh_count
+        for _ in range(12):
+            if removed and rng.random() < 0.5:
+                a, pa, b, pb = removed.pop()
+                for db in (dense, hier):
+                    db.add_link(Link(Port(a, pa), Port(b, pb)))
+                    db.add_link(Link(Port(b, pb), Port(a, pa)))
+            else:
+                a, pa, b, pb = cables[rng.randrange(len(cables))]
+                if dense.links.get(a, {}).get(b) is None:
+                    continue
+                removed.append((a, pa, b, pb))
+                for db in (dense, hier):
+                    db.delete_link(Link(Port(a, pa), Port(b, pb)))
+                    db.delete_link(Link(Port(b, pb), Port(a, pa)))
+            fd = dense.find_routes_batch(pairs)
+            fh = hier.find_routes_batch(pairs)
+            assert [len(x) for x in fd] == [len(y) for y in fh], spec.name
+        assert oracle.full_refresh_count == builds0, (
+            "link churn forced a full hierarchy rebuild"
+        )
+        assert oracle.repair_count > 0
+
+
+def test_hier_delta_narrowed_entry_point():
+    """routes_batch_delta under hier: touched verdicts match the py
+    backend's set-intersection differential."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(4)
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    py = spec.to_topology_db(backend="py")
+    pairs = _hosts_pairs(hier, n=6)
+    hier.find_routes_batch(pairs)
+    a, pa, b, pb = spec.links[0]
+    for db in (hier, py):
+        db.delete_link(Link(Port(a, pa), Port(b, pb)))
+        db.delete_link(Link(Port(b, pb), Port(a, pa)))
+    wr = hier.find_routes_batch_delta_dispatch(pairs, {a, b}).reap()
+    wp = py.find_routes_batch_delta_dispatch(pairs, {a, b}).reap()
+    assert wr.touched is not None
+    assert [int(x) for x in wr.hop_len] == [int(x) for x in wp.hop_len]
+    assert wr.touched.tolist() == wp.touched.tolist()
+
+
+# -- policies over the hierarchy ------------------------------------------
+
+
+def test_hier_balanced_and_adaptive_keep_lengths():
+    """Utilization steering picks among equal-length borders only —
+    every policy's lengths equal the shortest fence."""
+    spec = fattree(4)
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(hier, n=8)
+    base = [len(f) for f in hier.find_routes_batch(pairs)]
+    util = {(1, 1): 9e9, (2, 2): 3e9}
+    bal, maxc = hier.find_routes_batch_balanced(pairs, link_util=util)
+    assert [len(f) for f in bal] == base and maxc > 0
+    ad, detours, _ = hier.find_routes_batch_adaptive(pairs, link_util=util)
+    assert [len(f) for f in ad] == base and detours == 0
+    for (src, dst), fdb in zip(pairs, bal):
+        _assert_valid(hier, fdb, dst)
+
+
+def test_hier_steering_splits_equal_cost_borders():
+    """A loaded border switch loses equal-length ties: steering must
+    actually move CROSS-POD traffic off a fat-tree pod's loaded agg
+    (without changing any length). Same-pod intra chases are
+    deliberately unsteered, so the fence looks only at cross-pod
+    pairs' border choices."""
+    spec = fattree(4)
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    hosts = sorted(hier.hosts)
+    # fattree(4): hosts 0-3 sit in pod 0 (edges 7/8), hosts 4-7 in
+    # pod 1 (edges 11/12); agg(pod0, 0) is dpid 5, agg(pod0, 1) dpid 6
+    pairs = [(a, b) for a in hosts[:4] for b in hosts[4:8]]
+    idle = hier.find_routes_batch(pairs)
+    loaded, _ = hier.find_routes_batch_balanced(
+        pairs, link_util={(5, p): 9e9 for p in range(1, 5)}
+    )
+    assert [len(f) for f in idle] == [len(f) for f in loaded]
+    riders = {d for fdb in loaded for d, _ in fdb}
+    idle_riders = {d for fdb in idle for d, _ in fdb}
+    assert 5 in idle_riders, "idle tie-break should pick the lowest agg"
+    assert 5 not in riders, "steering never moved off the loaded agg"
+
+
+def test_hier_collective_matches_dense_lengths():
+    spec = fattree(4)
+    dense = spec.to_topology_db(backend="jax")
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    macs = sorted(dense.hosts)[:8]
+    n = len(macs)
+    src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    off = src != dst
+    src_idx = src[off].astype(np.int32)
+    dst_idx = dst[off].astype(np.int32)
+    cd = dense.find_routes_collective(macs, src_idx, dst_idx, "shortest")
+    ch = hier.find_routes_collective(macs, src_idx, dst_idx, "balanced")
+    assert ch.routed_mask().all()
+    assert [len(f) for f in cd.fdbs()] == [len(f) for f in ch.fdbs()]
+    assert ch.max_congestion > 0
+    # endpoint LUT contract (the block-install path reads it)
+    assert ch.endpoint_port is not None and (ch.endpoint_port >= 0).all()
+
+
+def test_hier_phased_program_covers_all_pairs():
+    spec = fattree(4)
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    macs = sorted(hier.hosts)[:6]
+    n = len(macs)
+    src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    off = src != dst
+    prog = hier.find_routes_collective_phased(
+        macs, src[off].astype(np.int32), dst[off].astype(np.int32),
+        policy="balanced", n_phases=2,
+    )
+    prog.reap_all()
+    assert (prog.pair_phase >= 0).all()
+    covered = np.zeros(int(off.sum()), bool)
+    for plan in prog.phases:
+        routes = plan.window.reap()
+        assert routes.routed_mask().all()
+        covered[plan.pair_idx] = True
+    assert covered.all()
+
+
+def test_hier_route_cache_hit_is_miss():
+    """The route cache sits in front of the hier oracle unchanged:
+    hit == miss bit-identical, and a link delta evicts riders."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(4)
+    db = spec.to_topology_db(
+        backend="jax", hier_oracle=True, route_cache=True
+    )
+    pairs = _hosts_pairs(db, n=6)
+    miss = db.find_routes_batch_dispatch(pairs).reap()
+    hit = db.find_routes_batch_dispatch(pairs).reap()
+    assert hit is miss  # the stored object IS the prior reap
+    a, pa, b, pb = spec.links[0]
+    db.delete_link(Link(Port(a, pa), Port(b, pb)))
+    db.delete_link(Link(Port(b, pb), Port(a, pa)))
+    again = db.find_routes_batch_dispatch(pairs).reap()
+    assert again is not miss  # delta invalidation reached the memo
+
+
+# -- default-off pin + scalar APIs ----------------------------------------
+
+
+def test_hier_default_off_keeps_dense_oracle():
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.oracle.engine import RouteOracle
+    from sdnmpi_tpu.oracle.hier import HierOracle
+
+    assert Config().hier_oracle is False
+    dense = fattree(4).to_topology_db(backend="jax")
+    assert type(dense._jax_oracle()) is RouteOracle
+    hier = fattree(4).to_topology_db(backend="jax", hier_oracle=True)
+    assert type(hier._jax_oracle()) is HierOracle
+
+
+def test_hier_scalar_apis():
+    spec = fattree(4)
+    dense = spec.to_topology_db(backend="jax")
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    mac_a, mac_b = sorted(dense.hosts)[0], sorted(dense.hosts)[-1]
+    fd = dense.find_route(mac_a, mac_b)
+    fh = hier.find_route(mac_a, mac_b)
+    assert len(fd) == len(fh)
+    all_d, _ = dense.find_all_routes(mac_a, mac_b, max_paths=16)
+    all_h, _ = hier.find_all_routes(mac_a, mac_b, max_paths=16)
+    assert {len(f) for f in all_d} == {len(f) for f in all_h}
+    ws = hier.warm_serving()
+    assert ws["max_len"] > 0 and ws["warm_s"] >= 0
+
+
+# -- sharded / ring executors ---------------------------------------------
+
+
+def test_hier_sharded_and_ring_match_single_device(virtual_mesh):
+    spec = fattree(8)
+    ref = spec.to_topology_db(backend="jax", hier_oracle=True)
+    sh = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=N_VIRTUAL_DEVICES
+    )
+    ri = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=N_VIRTUAL_DEVICES,
+        ring_exchange=True,
+    )
+    pairs = _hosts_pairs(ref, n=10)
+    f0 = ref.find_routes_batch(pairs)
+    assert f0 == sh.find_routes_batch(pairs)
+    assert f0 == ri.find_routes_batch(pairs)
+    state = sh._jax_oracle()._hier
+    assert state.device_bytes() > 0, "no device-resident pod shards"
+
+
+def test_hier_ring_border_plane_bit_identical(virtual_mesh):
+    """The ring-exchanged border-distance plane equals the direct host
+    slice of the pod blocks, bf16 wire included."""
+    from sdnmpi_tpu.shardplane.hier import ring_exchange_border_plane
+
+    spec = fattree(8)
+    db = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=N_VIRTUAL_DEVICES,
+        ring_exchange=True,
+    )
+    db.find_routes_batch(_hosts_pairs(db, n=4))
+    state = db._jax_oracle()._hier
+    planes = ring_exchange_border_plane(state)
+    for bi, b in enumerate(state.buckets):
+        for i, p in enumerate(b.pods):
+            lo = int(state.pod_bstart[p])
+            hi = int(state.pod_bstart[p + 1])
+            bl = state.border_local[lo:hi]
+            direct = b.dist[i][bl, :]
+            np.testing.assert_array_equal(planes[bi][i, : hi - lo], direct)
+
+
+def test_hier_row_sweep_device_matches_host(virtual_mesh):
+    from sdnmpi_tpu.oracle.hier import sweep_rows_host
+    from sdnmpi_tpu.shardplane.hier import sweep_rows_sharded
+
+    spec = dragonfly(4, 4, 1, 2)
+    db = spec.to_topology_db(backend="jax", hier_oracle=True)
+    db.find_routes_batch(_hosts_pairs(db, n=4))
+    st = db._jax_oracle()._hier
+    targets = np.arange(st.n_borders, dtype=np.int64)
+    host = sweep_rows_host(st.deg_buckets, st.n_borders, targets)
+    dev, dev_handle = sweep_rows_sharded(
+        st.deg_buckets, st.n_borders, targets, virtual_mesh
+    )
+    np.testing.assert_array_equal(host, dev)
+    assert dev_handle is not None
+
+
+# -- controller-level fence (sim + wire) ----------------------------------
+
+
+@pytest.mark.parametrize("wire", [False, True])
+def test_controller_fence_hier_vs_dense(wire):
+    """The whole control plane (discovered fabric -> partitioner
+    fallback): a block-installed alltoall under hier_oracle rides the
+    same number of flows (lengths equal => row counts equal) and
+    delivers on the data plane, vs the dense controller."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.announcement import (
+        Announcement,
+        AnnouncementType,
+    )
+    from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+    n_ranks = 6
+    installs = {}
+    for hier in (False, True):
+        spec = fattree(4)
+        fabric = spec.to_fabric(wire=wire)
+        config = Config(block_install_threshold=1, hier_oracle=hier)
+        controller = Controller(fabric, config)
+        controller.attach()
+        macs = sorted(fabric.hosts)[:n_ranks]
+        for rank, mac in enumerate(macs):
+            fabric.hosts[mac].send(of.Packet(
+                eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff",
+                eth_type=of.ETH_TYPE_IP, ip_proto=of.IPPROTO_UDP,
+                udp_dst=config.announcement_port,
+                payload=Announcement(
+                    AnnouncementType.LAUNCH, rank
+                ).encode(),
+            ))
+        vmac = VirtualMac(CollectiveType.ALLTOALL, 0, 1).encode()
+        fabric.hosts[macs[0]].send(of.Packet(
+            eth_src=macs[0], eth_dst=vmac, eth_type=of.ETH_TYPE_IP,
+        ))
+        table = controller.router.collectives
+        assert len(table) == 1
+        install = next(iter(table))
+        before = len(fabric.hosts[macs[2]].received)
+        fabric.hosts[macs[1]].send(of.Packet(
+            eth_src=macs[1],
+            eth_dst=VirtualMac(CollectiveType.ALLTOALL, 1, 2).encode(),
+            eth_type=of.ETH_TYPE_IP,
+        ))
+        assert len(fabric.hosts[macs[2]].received) > before
+        installs[hier] = install
+    dense_i, hier_i = installs[False], installs[True]
+    assert dense_i.n_pairs == hier_i.n_pairs
+    # lengths bit-identical => identical total flow-row count
+    assert dense_i.n_flows == hier_i.n_flows
+
+
+# -- bench config 15 machinery (CI fence, no TPU needed) -------------------
+
+
+class TestConfig15Machinery:
+    def test_small_fence_and_rows(self, virtual_mesh):
+        from benchmarks.config15_hier import (
+            MEM_HEADROOM_MIN,
+            fence_small,
+            measure_headline,
+            measure_refresh_twin,
+        )
+
+        assert "dense==hier" in fence_small()
+        row = measure_headline(
+            k=8, pods=12, hosts_per_edge=1, n_ranks=8,
+            mesh_devices=N_VIRTUAL_DEVICES, iters=1,
+        )
+        assert row["n_switches"] == 16 + 12 * 8
+        assert row["n_pairs"] == 8 * 7
+        assert row["peak_device_bytes"] > 0
+        assert row["vs_baseline"] == (
+            row["dense_plane_bytes"] / row["peak_device_bytes"]
+        )
+        assert MEM_HEADROOM_MIN == 8.0
+        twin = measure_refresh_twin(k=8, mesh_devices=N_VIRTUAL_DEVICES)
+        assert twin["value"] > 0 and twin["vs_baseline"] > 0
+
+    def test_registered_in_run_py(self):
+        from benchmarks.run import CONFIGS
+
+        assert any(name == "15" for name, _ in CONFIGS)
+
+    def test_committed_rows_gate(self):
+        """The committed config-15 rows: schema-complete, the memory
+        headroom >= the acceptance bound (peak per-device < 1/8 of the
+        dense plane), and the hier refresh inside 1.5x dense — a
+        hier-quality regression that sneaks into the suite file fails
+        CI without a TPU."""
+        import json
+        import pathlib
+
+        from benchmarks.config15_hier import (
+            MEM_HEADROOM_MIN,
+            REFRESH_RATIO_MAX,
+        )
+        from benchmarks.run import REQUIRED_ROW_KEYS, check_rows
+
+        suite = json.loads(
+            (pathlib.Path(__file__).parent.parent / "BENCH_suite.json")
+            .read_text()
+        )
+        rows = {
+            r["config"]: r for r in suite
+            if r.get("config", "").startswith("15")
+        }
+        assert set(rows) >= {"15", "15b"}, "config-15 rows not committed"
+        assert not check_rows(list(rows.values()))
+        head = rows["15"]
+        assert all(k in head for k in REQUIRED_ROW_KEYS)
+        assert head["n_switches"] == 65536
+        assert head["vs_baseline"] >= MEM_HEADROOM_MIN
+        assert (
+            head["peak_device_bytes"] * 8 < head["dense_plane_bytes"]
+        )
+        twin = rows["15b"]
+        assert twin["vs_baseline"] >= 1.0 / REFRESH_RATIO_MAX
+
+
+def test_hier_ring_churn_repair_stays_fenced(virtual_mesh):
+    """Review regression (PR 13): a block repair must refresh the
+    DEVICE twins it carries — the ring-exchanged border plane reads
+    them, so a stale carry would rebuild level 2 from pre-delta
+    distances. Churn an intra-pod link under mesh + ring and hold the
+    dense length fence through the repair path."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(8)
+    dense = spec.to_topology_db(backend="jax")
+    ring = spec.to_topology_db(
+        backend="jax", hier_oracle=True, mesh_devices=N_VIRTUAL_DEVICES,
+        ring_exchange=True,
+    )
+    pairs = _hosts_pairs(dense, n=8)
+    assert [len(f) for f in dense.find_routes_batch(pairs)] == [
+        len(f) for f in ring.find_routes_batch(pairs)
+    ]
+    oracle = ring._jax_oracle()
+    builds0 = oracle.full_refresh_count
+    # an intra-pod delete (edge<->agg inside pod 0), then its re-add:
+    # both classify as repairable intra-pod deltas
+    pm = spec.podmap
+    intra = next(
+        (a, pa, b, pb) for a, pa, b, pb in spec.links
+        if pm.pod_of.get(a) == pm.pod_of.get(b)
+    )
+    a, pa, b, pb = intra
+    for step in range(2):
+        for db in (dense, ring):
+            if step == 0:
+                db.delete_link(Link(Port(a, pa), Port(b, pb)))
+                db.delete_link(Link(Port(b, pb), Port(a, pa)))
+            else:
+                db.add_link(Link(Port(a, pa), Port(b, pb)))
+                db.add_link(Link(Port(b, pb), Port(a, pa)))
+        assert [len(f) for f in dense.find_routes_batch(pairs)] == [
+            len(f) for f in ring.find_routes_batch(pairs)
+        ], f"ring hier drifted from dense at churn step {step}"
+    assert oracle.full_refresh_count == builds0, "repair path not taken"
+
+
+def test_hier_zero_border_pod_routes_without_crash():
+    """Review regression (PR 13): a pod whose every inter-pod link was
+    severed has ZERO borders; a mixed-pod window must route the
+    healthy pairs and return [] for the severed ones (the dense
+    contract), never walk another pod's border list (the out-of-bucket
+    IndexError)."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(8)
+    dense = spec.to_topology_db(backend="jax")
+    hier = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pm = spec.podmap
+    core = {d for d, p in pm.pod_of.items() if p == pm.n_pods - 1}
+    for a, pa, b, pb in spec.links:
+        if b in core and pm.pod_of[a] == 0:
+            for db in (dense, hier):
+                db.delete_link(Link(Port(a, pa), Port(b, pb)))
+                db.delete_link(Link(Port(b, pb), Port(a, pa)))
+    hosts = sorted(dense.hosts)
+    # pod 0's hosts are the first 16 (4 edges x 4); mix severed +
+    # healthy endpoints in one window
+    pairs = [
+        (hosts[0], hosts[20]), (hosts[20], hosts[0]),
+        (hosts[1], hosts[2]), (hosts[20], hosts[30]),
+    ]
+    fd = dense.find_routes_batch(pairs)
+    fh = hier.find_routes_batch(pairs)
+    assert [len(x) for x in fd] == [len(y) for y in fh]
+    assert len(fh[0]) == 0 and len(fh[3]) > 0

@@ -301,6 +301,19 @@ class TestRecoveryFlags:
         assert cfg.echo_interval_s == 3.0 and cfg.echo_timeout_s == 9.0
         assert args.chaos == 42
 
+    def test_hier_oracle_flags_map_to_config(self):
+        """--hier-oracle / --hier-pod-target wire Config.hier_oracle
+        (default off — the dense path, byte-identical)."""
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.hier_oracle is False and cfg.hier_pod_target == 0
+        cfg = launch.config_from_args(_parse([
+            "--hier-oracle", "--hier-pod-target", "64",
+            "--mesh-devices", "8",
+        ]))
+        assert cfg.hier_oracle is True
+        assert cfg.hier_pod_target == 64
+        assert cfg.mesh_devices == 8
+
     def test_ring_exchange_flags_map_to_config(self):
         """--ring-exchange / --no-ring-exchange wire Config.ring_exchange
         (default off — the PR-9 gather path); the last flag wins."""

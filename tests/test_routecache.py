@@ -476,3 +476,265 @@ class TestUtilEpochKeying:
         assert k1 == k2
         kb = rc.collective_key(["a", "b"], [0], [1], "balanced", Plane(), {})
         assert kb[2] == 9
+
+
+# -- restart persistence (ISSUE 13 satellite) ------------------------------
+
+
+class TestRestartPersistence:
+    def test_snapshot_roundtrip_restores_the_hit(self):
+        import json as _json
+
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        wr = cached.find_routes_batch_dispatch(pairs).reap()
+        snap = _json.loads(_json.dumps(
+            cached.route_cache.snapshot_entries(cached)
+        ))
+        fresh = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        assert fresh.route_cache.restore_entries(snap, fresh) == 1
+        hits0 = _counter("route_cache_hits_total")
+        hit = fresh.find_routes_batch_dispatch(pairs).reap()
+        assert _counter("route_cache_hits_total") == hits0 + 1
+        assert_windows_equal(hit, wr)
+
+    def test_restore_refuses_mismatched_topology(self):
+        cached, _ = _dbs()
+        cached.find_routes_batch_dispatch(_pairs(cached)).reap()
+        snap = cached.route_cache.snapshot_entries(cached)
+        other = fattree(8).to_topology_db(backend="jax", route_cache=True)
+        assert other.route_cache.restore_entries(snap, other) == 0
+
+    def test_restore_refuses_unknown_format_version(self):
+        cached, _ = _dbs()
+        cached.find_routes_batch_dispatch(_pairs(cached)).reap()
+        snap = cached.route_cache.snapshot_entries(cached)
+        snap["version"] = 99
+        fresh = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        assert fresh.route_cache.restore_entries(snap, fresh) == 0
+
+    def test_util_keyed_entries_never_serialize(self):
+        """UtilPlane epochs restart from zero, so balanced/collective
+        entries (epoch-keyed) must not survive a restart."""
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        cached.find_routes_batch_dispatch(pairs).reap()
+        cached.find_routes_batch_dispatch(pairs, policy="balanced").reap()
+        assert len(cached.route_cache) == 2
+        snap = cached.route_cache.snapshot_entries(cached)
+        assert len(snap["entries"]) == 1
+        assert snap["entries"][0]["result"]["kind"] == "window"
+
+    def test_restored_entries_still_invalidate_through_deltas(self):
+        from sdnmpi_tpu.core.topology_db import Link, Port
+
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        wr = cached.find_routes_batch_dispatch(pairs).reap()
+        snap = cached.route_cache.snapshot_entries(cached)
+        fresh = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        assert fresh.route_cache.restore_entries(snap, fresh) == 1
+        # delete a ridden link: the restored entry must evict and the
+        # re-dispatch must route around it
+        a, pa = int(wr.hop_dpid[0, 0]), int(wr.hop_port[0, 0])
+        b = int(wr.hop_dpid[0, 1])
+        pb = fresh.links[b][a].src.port_no
+        fresh.delete_link(Link(Port(a, pa), Port(b, pb)))
+        fresh.delete_link(Link(Port(b, pb), Port(a, pa)))
+        again = fresh.find_routes_batch_dispatch(pairs).reap()
+        riders = set(again.hop_dpid[0].tolist())
+        assert not (
+            a in riders
+            and b in riders
+            and abs(
+                again.hop_dpid[0].tolist().index(a)
+                - again.hop_dpid[0].tolist().index(b)
+            ) == 1
+        )
+
+    def test_controller_checkpoint_carries_the_memo(self, tmp_path):
+        """End to end through api/snapshot: a restarted controller's
+        first repeat window is a HIT on the restored memo."""
+        from sdnmpi_tpu.api.snapshot import load_checkpoint, save_checkpoint
+
+        path = tmp_path / "ckpt.json"
+        fabric, controller, hosts = _controller_stack(route_cache=True)
+        db = controller.topology_manager.topologydb
+        pairs = [(MACS[0], MACS[2]), (MACS[1], MACS[3])]
+        db.find_routes_batch_dispatch(pairs).reap()
+        assert len(db.route_cache) == 1
+        save_checkpoint(controller, path)
+
+        fabric2, controller2, _ = _controller_stack(route_cache=True)
+        db2 = controller2.topology_manager.topologydb
+        assert len(db2.route_cache) == 0
+        load_checkpoint(controller2, path)
+        assert len(db2.route_cache) >= 1
+        hits0 = _counter("route_cache_hits_total")
+        db2.find_routes_batch_dispatch(pairs).reap()
+        assert _counter("route_cache_hits_total") == hits0 + 1
+
+
+# -- narrowed link-ADD invalidation (ISSUE 13 satellite) -------------------
+
+
+class TestNarrowedLinkAdd:
+    """An add whose endpoints are both interior to one pod of a
+    generator-certified PodMap evicts only that pod's riders (the
+    soundness argument lives with narrowed_dirty_set in
+    core/topology_db.py)."""
+
+    @staticmethod
+    def _add_intra(db, a, pa, b, pb):
+        from sdnmpi_tpu.core.topology_db import Link, Port
+
+        db.add_link(Link(Port(a, pa), Port(b, pb)))
+        db.add_link(Link(Port(b, pb), Port(a, pa)))
+
+    def test_interior_add_evicts_only_the_pods_riders(self):
+        # fattree(4): pod 2's edges are dpids 15/16 (interior: only
+        # aggs 13/14 border the pod); pods 0/1 host the surviving pair
+        cached = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        macs = sorted(cached.hosts)
+        survivor = [(macs[0], macs[4])]  # pod 0 -> pod 1
+        rider = [(macs[8], macs[0])]  # pod 2 -> pod 0
+        cached.find_routes_batch_dispatch(survivor).reap()
+        cached.find_routes_batch_dispatch(rider).reap()
+        assert len(cached.route_cache) == 2
+        self._add_intra(cached, 15, 61, 16, 61)
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 1  # only pod 2's rider fell
+        hits0 = _counter("route_cache_hits_total")
+        cached.find_routes_batch_dispatch(survivor).reap()
+        assert _counter("route_cache_hits_total") == hits0 + 1
+        # and the narrowing is SOUND here: a fresh oracle on the
+        # post-add fabric routes the surviving pair identically
+        fresh = fattree(4).to_topology_db(backend="jax")
+        self._add_intra(fresh, 15, 61, 16, 61)
+        direct = fresh.find_routes_batch(survivor)
+        hit = cached.find_routes_batch_dispatch(survivor).reap()
+        assert hit.fdbs() == direct
+
+    def test_border_endpoint_add_clears(self):
+        cached = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        macs = sorted(cached.hosts)
+        cached.find_routes_batch_dispatch([(macs[0], macs[4])]).reap()
+        self._add_intra(cached, 13, 61, 14, 61)  # agg-agg: both borders
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 0
+
+    def test_uncertified_podmap_clears(self):
+        from sdnmpi_tpu.topogen import PodMap
+
+        cached = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        pm = cached.podmap
+        cached.podmap = PodMap(
+            pod_of=dict(pm.pod_of), n_pods=pm.n_pods,
+            intra_add_narrows=False,
+        )
+        macs = sorted(cached.hosts)
+        cached.find_routes_batch_dispatch([(macs[0], macs[4])]).reap()
+        self._add_intra(cached, 15, 61, 16, 61)
+        cached.route_cache.sync(cached)
+        assert len(cached.route_cache) == 0
+
+    def test_narrowed_dirty_set_rules(self):
+        from sdnmpi_tpu.core.topology_db import narrowed_dirty_set
+
+        db = fattree(4).to_topology_db(backend="jax")
+        pm = db.podmap
+        # interior add -> the pod's member set
+        deltas = [(1, "link+", 15, 16, 61)]
+        dirty = narrowed_dirty_set(deltas, pm, db)
+        assert dirty == set(pm.members()[2])
+        # border endpoint -> None (clear)
+        assert narrowed_dirty_set(
+            [(1, "link+", 13, 14, 61)], pm, db
+        ) is None
+        # cross-pod add -> None
+        assert narrowed_dirty_set(
+            [(1, "link+", 15, 11, 61)], pm, db
+        ) is None
+        # no podmap / no borders_fn -> the PR-11 rules (adds clear)
+        assert narrowed_dirty_set(deltas) is None
+        assert narrowed_dirty_set(deltas, pm, None) is None
+        # mixed delete + interior add composes both dirty sets
+        mixed = [(1, "link-", 5, 1), (2, "link+", 15, 16, 61)]
+        dirty = narrowed_dirty_set(mixed, pm, db)
+        assert dirty == {5, 1} | set(pm.members()[2])
+
+    def test_degraded_pod_defeats_the_add_cert(self):
+        """Review regression (PR 13): the generator's intra_add_narrows
+        fact is re-validated LIVE. Cut a fat-tree pod's two agg-edge
+        diagonals so its aggs lose their distance-2 meeting points in
+        one direction pair, then an interior edge-edge add REALLY can
+        revive a border-to-border transit (at length 3) — the
+        narrowing must refuse and clear."""
+        from sdnmpi_tpu.core.topology_db import (
+            Link,
+            Port,
+            narrowed_dirty_set,
+        )
+
+        db = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        pm = db.podmap
+        # pod 0: aggs 5/6 (borders), edges 7/8 (interior). Cut 5-8 and
+        # 6-7: agg 5 and agg 6 now share NO edge switch.
+        for a, b in ((5, 8), (6, 7)):
+            pa = db.links[a][b].src.port_no
+            pb = db.links[b][a].src.port_no
+            db.delete_link(Link(Port(a, pa), Port(b, pb)))
+            db.delete_link(Link(Port(b, pb), Port(a, pa)))
+        deltas = [(db.version + 1, "link+", 7, 8, 61)]
+        assert narrowed_dirty_set(deltas, pm, db) is None
+        # and end to end: the cache clears instead of narrowing
+        macs = sorted(db.hosts)
+        db.find_routes_batch_dispatch([(macs[0], macs[4])]).reap()
+        db.route_cache.sync(db)  # absorb the deletes first
+        self._add_intra(db, 7, 61, 8, 61)
+        db.route_cache.sync(db)
+        assert len(db.route_cache) == 0
+
+
+class TestRestorePendingDeltas:
+    def test_restore_settles_live_entries_pending_invalidation(self):
+        """Review regression (PR 13): restore_entries must run the
+        normal invalidation sweep for entries ALREADY live before
+        rebasing the sync version — restore_controller mutates the db
+        (host adds) right before restoring, and those deltas normally
+        clear the cache."""
+        from sdnmpi_tpu.core.topology_db import Host, Port
+
+        cached, _ = _dbs()
+        pairs = _pairs(cached)
+        cached.find_routes_batch_dispatch(pairs).reap()
+        snap = cached.route_cache.snapshot_entries(cached)
+
+        live = fattree(4).to_topology_db(backend="jax", route_cache=True)
+        live.find_routes_batch_dispatch(pairs).reap()
+        assert len(live.route_cache) == 1  # a LIVE entry, synced
+        # an un-synced host delta: would normally CLEAR on next sync
+        live.add_host(Host("04:00:00:00:99:99", Port(1, 9)))
+        # restore lands 0 entries (digest moved with the new host) but
+        # must still have settled the pending clear for the live entry
+        assert live.route_cache.restore_entries(snap, live) == 0
+        assert len(live.route_cache) == 0
+
+    def test_snapshot_settles_pending_deltas_before_digesting(self):
+        """Review regression (PR 13): snapshot_entries stamps the
+        CURRENT graph's digest, so it must sync pending deltas first —
+        an entry riding a just-deleted link must not be serialized
+        under a digest the restarted controller will match."""
+        from sdnmpi_tpu.core.topology_db import Link, Port
+
+        cached, _ = _dbs()
+        pairs = _pairs(cached, n=1)
+        wr = cached.find_routes_batch_dispatch(pairs).reap()
+        a, pa = int(wr.hop_dpid[0, 0]), int(wr.hop_port[0, 0])
+        b = int(wr.hop_dpid[0, 1])
+        pb = cached.links[b][a].src.port_no
+        # delete a ridden link with NO intervening dispatch (no sync)
+        cached.delete_link(Link(Port(a, pa), Port(b, pb)))
+        cached.delete_link(Link(Port(b, pb), Port(a, pa)))
+        snap = cached.route_cache.snapshot_entries(cached)
+        assert snap["entries"] == []  # the rider was settled, not saved

@@ -444,3 +444,107 @@ class TestConfig14Machinery:
         assert warm["served"] and cold["served"]
         assert warm_ms < 5000.0
         assert warm["route_ms"] < 1000.0
+
+
+class TestWfqCoalescer:
+    """Weighted fair queueing between bulk tenants in the two-class
+    coalescer (Config.coalesce_wfq_weights, ISSUE 13 satellite)."""
+
+    @staticmethod
+    def _pend(src, bulk=True):
+        from sdnmpi_tpu.control.router import _PendingRoute
+
+        return _PendingRoute(
+            src=src, dst="d", true_dst=None, dpid=1, in_port=1,
+            pkt=None, buffer_id=of.OFP_NO_BUFFER, bulk=bulk,
+        )
+
+    def test_room_splits_proportionally_to_weights(self):
+        fabric, controller = serving_stack(coalesce_max_batch=6)
+        router = controller.router
+        router.config.coalesce_wfq_weights = {"A": 2.0, "B": 1.0}
+        for i in range(6):
+            router.admission.assign(f"a{i}", "A")
+            router.admission.assign(f"b{i}", "B")
+        router._pending.extend(
+            [self._pend(f"a{i}") for i in range(6)]
+            + [self._pend(f"b{i}") for i in range(6)]
+        )
+        window = router._next_window()
+        # weight 2:1 over room 6 -> 4 A slots, 2 B slots, each tenant
+        # in its own arrival order — A's backlog can no longer shut B
+        # out of the window entirely
+        assert [p.src for p in window] == [
+            "a0", "a1", "a2", "a3", "b0", "b1",
+        ]
+
+    def test_empty_weights_keep_arrival_order(self):
+        """The default: byte-identical to the PR-11 arrival-order
+        bulk fill (the A storm takes the whole window)."""
+        fabric, controller = serving_stack(coalesce_max_batch=6)
+        router = controller.router
+        assert router.config.coalesce_wfq_weights == {}
+        for i in range(6):
+            router.admission.assign(f"a{i}", "A")
+            router.admission.assign(f"b{i}", "B")
+        router._pending.extend(
+            [self._pend(f"a{i}") for i in range(6)]
+            + [self._pend(f"b{i}") for i in range(6)]
+        )
+        assert [p.src for p in router._next_window()] == [
+            f"a{i}" for i in range(6)
+        ]
+
+    def test_short_backlog_donates_surplus(self):
+        """A heavy-weight tenant with little backlog donates its
+        unused share — no slot is wasted."""
+        fabric, controller = serving_stack(coalesce_max_batch=6)
+        router = controller.router
+        router.config.coalesce_wfq_weights = {"A": 3.0, "B": 1.0}
+        router.admission.assign("a0", "A")
+        for i in range(8):
+            router.admission.assign(f"b{i}", "B")
+        router._pending.extend(
+            [self._pend("a0")] + [self._pend(f"b{i}") for i in range(8)]
+        )
+        window = router._next_window()
+        assert [p.src for p in window] == [
+            "a0", "b0", "b1", "b2", "b3", "b4",
+        ]
+
+    def test_latency_sensitive_class_untouched(self):
+        """WFQ divides only the BULK room; the latency-sensitive class
+        still jumps every bulk backlog."""
+        fabric, controller = serving_stack(coalesce_max_batch=6)
+        router = controller.router
+        router.config.coalesce_wfq_weights = {"A": 1.0, "B": 1.0}
+        for i in range(4):
+            router.admission.assign(f"a{i}", "A")
+            router.admission.assign(f"b{i}", "B")
+        router._pending.extend(
+            [self._pend("ls0", bulk=False)]
+            + [self._pend(f"a{i}") for i in range(4)]
+            + [self._pend(f"b{i}") for i in range(4)]
+        )
+        window = router._next_window()
+        assert window[0].src == "ls0"
+        # room 5 at weight 1:1 -> 3 A (largest-remainder tie to the
+        # lexicographically-first tenant) + 2 B
+        assert [p.src for p in window] == [
+            "ls0", "a0", "a1", "a2", "b0", "b1",
+        ]
+
+    def test_unlisted_tenants_weigh_one(self):
+        fabric, controller = serving_stack(coalesce_max_batch=4)
+        router = controller.router
+        router.config.coalesce_wfq_weights = {"A": 1.0}
+        for i in range(4):
+            router.admission.assign(f"a{i}", "A")
+        # b* MACs are never assigned: each is its own tenant, weight 1
+        router._pending.extend(
+            [self._pend(f"a{i}") for i in range(4)]
+            + [self._pend("b0"), self._pend("b0")]
+        )
+        window = router._next_window()
+        # three tenants present (A, b0) -> A 2 slots, b0 2 slots
+        assert [p.src for p in window] == ["a0", "a1", "b0", "b0"]

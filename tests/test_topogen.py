@@ -291,3 +291,149 @@ class TestBasic:
         fabric = spec.to_fabric()
         assert sorted(fabric.switches) == [1, 2, 3]
         assert len(fabric.hosts) == 3
+
+
+# -- PodMap annotations (ISSUE 13) ---------------------------------------
+
+
+class TestPodMap:
+    """PodMap invariants: every switch exactly one pod; border sets
+    consistent with the inter-pod link table; generator emissions and
+    the partitioner fallback deterministic."""
+
+    ANNOTATED = {
+        "fattree8": lambda: fattree(8),
+        "fattree4p6": lambda: fattree(4, pods=6),
+        "dragonfly": lambda: dragonfly(4, 4, 1, 2),
+    }
+
+    @staticmethod
+    def _directed(spec):
+        out = []
+        for a, _pa, b, _pb in spec.links:
+            out.append((a, b))
+            out.append((b, a))
+        return out
+
+    @pytest.mark.parametrize("name", sorted(ANNOTATED))
+    def test_every_switch_exactly_one_pod(self, name):
+        spec = self.ANNOTATED[name]()
+        pm = spec.podmap
+        assert pm is not None
+        assert set(pm.pod_of) == set(spec.switches)
+        assert all(0 <= p < pm.n_pods for p in pm.pod_of.values())
+        members = pm.members()
+        assert sorted(d for pod in members for d in pod) == sorted(
+            spec.switches
+        )
+        assert sum(len(pod) for pod in members) == len(spec.switches)
+
+    @pytest.mark.parametrize("name", sorted(ANNOTATED))
+    def test_border_sets_match_inter_pod_link_table(self, name):
+        from sdnmpi_tpu.topogen import border_sets, inter_pod_links
+
+        spec = self.ANNOTATED[name]()
+        pm = spec.podmap
+        borders = border_sets(pm.pod_of, self._directed(spec), pm.n_pods)
+        table = inter_pod_links(
+            pm.pod_of,
+            [(a, pa, b, pb) for a, pa, b, pb in spec.links]
+            + [(b, pb, a, pa) for a, pa, b, pb in spec.links],
+        )
+        from_table = set()
+        for a, _pa, b, _pb in table:
+            assert pm.pod_of[a] != pm.pod_of[b]
+            from_table.add(a)
+            from_table.add(b)
+        assert set().union(*borders) == from_table
+        for pod, bs in enumerate(borders):
+            assert all(pm.pod_of[d] == pod for d in bs)
+
+    def test_fattree_borders_are_aggs_and_cores(self):
+        from sdnmpi_tpu.topogen import border_sets
+
+        spec = fattree(4)
+        pm = spec.podmap
+        borders = border_sets(pm.pod_of, self._directed(spec), pm.n_pods)
+        for pod in range(4):  # regular pods border at their k/2 aggs
+            assert len(borders[pod]) == 2
+        assert len(borders[4]) == 4  # every core borders the core pod
+        assert pm.intra_add_narrows is True
+
+    def test_stretched_fattree_shape(self):
+        """fattree(k, pods=p) decouples pod count from arity — bench
+        config 15's 65k datacenter shape at miniature scale."""
+        spec = fattree(4, pods=6)
+        assert spec.n_switches == 4 + 6 * 4  # (k/2)^2 cores + pods * k
+        assert spec.podmap.n_pods == 7
+        no_duplicate_ports(spec)
+        core = set(range(1, 5))
+        assert sum(
+            1 for a, _, b, _ in spec.links if b in core
+        ) == 6 * 2 * 2  # every agg still uplinks to its k/2-core group
+
+    def test_dragonfly_groups_are_pods(self):
+        spec = dragonfly(4, 4, 1, 2)
+        pm = spec.podmap
+        assert pm.n_pods == 4
+        assert pm.intra_add_narrows is True
+        assert all(len(m) == 4 for m in pm.members())
+
+    def test_partitioner_covers_connected_and_deterministic(self):
+        from sdnmpi_tpu.topogen import podmap_for_db
+
+        spec = torus((4, 4))
+        assert spec.podmap is None  # torus ships unannotated
+        db = spec.to_topology_db()
+        pm1 = podmap_for_db(db)
+        pm2 = podmap_for_db(db)
+        assert pm1.pod_of == pm2.pod_of and pm1.n_pods == pm2.n_pods
+        assert set(pm1.pod_of) == set(db.switches)
+        assert pm1.intra_add_narrows is False  # never certified
+        for pod in pm1.members():  # contiguous growth: connected pods
+            seen = {pod[0]}
+            frontier = [pod[0]]
+            pod_set = set(pod)
+            while frontier:
+                nxt = []
+                for d in frontier:
+                    for nb in db.links.get(d, {}):
+                        if nb in pod_set and nb not in seen:
+                            seen.add(nb)
+                            nxt.append(nb)
+                frontier = nxt
+            assert seen == pod_set, "partitioner pod is disconnected"
+
+    def test_partitioner_target_size(self):
+        from sdnmpi_tpu.topogen import partition_pods
+
+        pm = partition_pods(
+            range(16), {i: [i - 1, i + 1] for i in range(16)},
+            target_size=4,
+        )
+        assert pm.n_pods == 4
+        assert all(len(m) == 4 for m in pm.members())
+
+    def test_podmap_for_db_prefers_covering_annotation(self):
+        from sdnmpi_tpu.core.topology_db import Switch
+        from sdnmpi_tpu.topogen import podmap_for_db
+
+        spec = fattree(4)
+        db = spec.to_topology_db()
+        assert podmap_for_db(db) is spec.podmap
+        # a stale annotation (a switch the generator never knew) falls
+        # back to the partitioner wholesale instead of guessing
+        db.add_switch(Switch.make(9999))
+        pm = podmap_for_db(db)
+        assert pm is not spec.podmap
+        assert 9999 in pm.pod_of
+
+    def test_roundtrip_and_unannotated(self):
+        from sdnmpi_tpu.topogen import PodMap
+
+        pm = fattree(4).podmap
+        clone = PodMap.from_dict(pm.to_dict())
+        assert clone.pod_of == pm.pod_of
+        assert clone.n_pods == pm.n_pods
+        assert clone.intra_add_narrows == pm.intra_add_narrows
+        assert linear(4).podmap is None
