@@ -248,11 +248,20 @@ def first_route_probe(cache_dir: str, k: int = 4) -> None:
         eth_src=macs[0], eth_dst=macs[1], payload=b"first",
     ))
     served = len(fabric.hosts[macs[1]].received) == 1
+    # warmup/compile-cache telemetry (ISSUE 14 satellite): the probe
+    # ships its registry figures so the restart test can assert the
+    # warm-start claim IS observable — a cold child counts misses, a
+    # warm child counts hits, and the warmup gauge carries the wall
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
     print(json.dumps({
         "in_process_ms": (time.perf_counter() - t0) * 1e3,
         "warm_ms": warm["warm_s"] * 1e3,
         "route_ms": (time.perf_counter() - t_route) * 1e3,
         "served": served,
+        "warmup_gauge_s": REGISTRY.get("serving_warmup_seconds").value,
+        "cache_hits": REGISTRY.get("compile_cache_hits_total").value,
+        "cache_misses": REGISTRY.get("compile_cache_misses_total").value,
     }), flush=True)
 
 

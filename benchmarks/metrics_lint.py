@@ -1,0 +1,313 @@
+"""Metrics-lint CI gate (ISSUE 14 satellite).
+
+Holds the telemetry plane honest from both sides, without a TPU:
+
+1. **Documentation completeness** — every instrument registered by any
+   subsystem (api/telemetry.INSTRUMENTED_MODULES) must have a row in
+   the README's generated metrics reference table, and every table row
+   must still name a live instrument. A new metric without docs, a
+   renamed metric with stale docs, or a prefix no owner claims
+   (``owner == "?"``) all fail.
+2. **Liveness under soak** — a short simulated serving soak (coalesced
+   windows, a proactive collective, Monitor stats, a link flap, an
+   admission storm, SLO targets, flight/timeline ticks) must MOVE
+   every metric outside the exempt set. A metric that stays zero
+   through all of that is either dead (registered but never touched —
+   the lint's reason to exist) or belongs in ``SOAK_EXEMPT`` with a
+   category comment.
+
+Wired beside the other no-TPU CI gates: ``python -m benchmarks.run
+--metrics-lint`` and tests/test_metrics_lint.py run the same
+:func:`run_metrics_lint`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+#: metrics a HEALTHY short soak legitimately leaves at zero, by
+#: category — everything else must move or the lint fails
+SOAK_EXEMPT = {
+    # incident/failure counters: zero IS the healthy reading
+    "southbound_drops_total",
+    "southbound_stall_cuts_total",
+    "echo_timeouts_total",
+    "barrier_timeouts_total",
+    "install_retries_total",
+    "install_retry_giveups_total",
+    "install_resyncs_total",
+    "monitor_stale_stats_total",
+    "trace_sink_errors_total",
+    "topology_delta_log_breaks_total",
+    "event_log_rotations_total",
+    "utilplane_decays_total",
+    "utilplane_rebuilds_total",
+    "oracle_repairs_total",  # repair needs delta-log-coverable churn
+    "reconcile_flows_total",  # a crash/redial cycle, not a flap
+    "reconcile_passes_total",
+    "recovery_redrive_seconds",
+    "slo_burn_triggers_total",  # an SLO burn is an incident
+    "flight_dumps_total",  # needs a dump dir
+    "profile_captures_total",  # needs --profile-dump + an anomaly
+    "router_reval_flows_drained_total",  # needs a drained re-route
+    "router_revalidations_skipped_total",
+    "route_cache_evictions_total",  # LRU pressure, not correctness
+    "device_memory_host_fallback",  # gauge VALUE is legitimately 0/1
+    "congestion_host_sampled",  # 0 = device pass served the report
+    # live gauges whose healthy steady-state reading is zero (depth /
+    # in-flight gauges return to 0 when the soak drains; attribution
+    # gauges read 0 with nothing hot)
+    "coalescer_queue_depth",
+    "pipeline_inflight_windows",
+    "barriers_pending",
+    "congestion_hot_collectives",
+    # bench-scale oracle figures the soak's batch sizes never reach
+    # (DAG threshold) — config 12/15 assert them at bench scale
+    "congestion_fractional_max",
+    "congestion_discrete_over_fractional",
+    # real-TCP southbound only (OFSouthbound windows/slices; the lint
+    # soaks the simulated wire fabric — tests/test_southbound.py
+    # asserts these over a live socket)
+    "southbound_sends_total",
+    "southbound_window_bytes",
+    "southbound_install_slices_total",
+    "southbound_slice_wait_seconds",
+    # config-gated subsystems the lint soak does not boot (their own
+    # test files assert their telemetry under the right configs)
+    "shard_",
+    "ring_",
+    "hier_",
+    "sched_",
+    "serving_warmup_seconds",  # --warm-serving
+    "compile_cache_",  # --compile-cache-dir
+    "fabric_",  # wire-mode byte counters (lint soaks the sim fabric)
+}
+
+
+def _exempt(name: str) -> bool:
+    for e in SOAK_EXEMPT:
+        if name == e or (e.endswith("_") and name.startswith(e)):
+            return True
+    return False
+
+
+def _moved(inst) -> bool:
+    """Did this instrument record anything since process start?"""
+    from sdnmpi_tpu.utils.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+        LabeledCounter,
+        LabeledHistogram,
+    )
+
+    if isinstance(inst, Counter):
+        return inst.value != 0
+    if isinstance(inst, Gauge):
+        return inst.value != 0.0
+    if isinstance(inst, Histogram):
+        return inst.count != 0
+    if isinstance(inst, LabeledCounter):
+        return bool(inst.values)
+    if isinstance(inst, LabeledHistogram):
+        return any(h.count for h in inst.children.values())
+    return True  # unknown kinds don't fail the soak
+
+
+def soak(duration_requests: int = 48) -> None:
+    """A short simulated serving soak touching every non-exempt
+    subsystem: coalesced unicast windows, a proactive collective, a
+    link flap (reval + cache invalidation + incremental repair path),
+    Monitor port stats + flush edges (utilplane, congestion, flight,
+    timeline, devprof sampling), an admission storm, and SLO-targeted
+    tenants."""
+    import tempfile
+
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control import events as ev
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.control.loadgen import register_ranks
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(4)
+    # wire mode: the southbound byte counters (encode bytes, window
+    # slices, sends) only move when real OF 1.0 bytes are written
+    fabric = spec.to_fabric(wire=True)
+    with tempfile.TemporaryDirectory() as td:
+        config = Config(
+            enable_monitor=True,
+            coalesce_routes=True,
+            coalesce_window_s=10.0,
+            # admit-all globally; the storm tenant below carries its
+            # own per-tenant rate override so the admission counters
+            # move without starving the serving rounds
+            admission_rate=0.0,
+            admission_burst=8.0,
+            slo_targets={"t0": (50.0, 0.999)},
+            event_log=str(pathlib.Path(td) / "events.jsonl"),
+            flow_idle_timeout=0,
+        )
+        controller = Controller(fabric, config)
+        controller.attach()
+        macs = sorted(fabric.hosts)
+        for mac in macs:
+            controller.router.admission.assign(mac, "t0")
+
+        # unicast serving windows (coalescer -> pipeline -> install)
+        pairs = [(macs[i], macs[(i + 1) % len(macs)])
+                 for i in range(len(macs))]
+        for i in range(duration_requests):
+            src, dst = pairs[i % len(pairs)]
+            h = fabric.hosts[src]
+            controller.bus.publish(ev.EventPacketIn(
+                h.dpid, h.port_no,
+                of.Packet(eth_src=src, eth_dst=dst, payload=b"soak"),
+                of.OFP_NO_BUFFER,
+            ))
+        controller.router.flush_routes()
+
+        # proactive collective (block install, congestion attribution)
+        ranks = register_ranks(fabric, config, macs[:4])
+        vmac = VirtualMac(
+            CollectiveType.ALLTOALL, ranks[0], ranks[1]
+        ).encode()
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=vmac,
+                      eth_type=of.ETH_TYPE_IP),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+
+        # route-cache hit: the same window served twice (second lookup
+        # hits the memo; do this BEFORE the flap clears the cache)
+        db = controller.topology_manager.topologydb
+        cache_pairs = pairs[:8]
+        db.find_routes_batch_dispatch(list(cache_pairs)).reap()
+        db.find_routes_batch_dispatch(list(cache_pairs)).reap()
+
+        # unroutable unicast: a destination no host owns (globally-
+        # administered MAC — the 0x02 bit would read as an MPI vMAC)
+        # falls back to controlled broadcast and counts unroutable
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst="00:de:ad:be:ef:99",
+                      payload=b"lost"),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+
+        # one manual diagnostic freeze: the pull-mode flight_dump leg
+        # (flight_anomalies_total{trigger=manual})
+        controller.bus.request(ev.FlightDumpRequest())
+
+        # Monitor passes: two synchronous polls a second apart (the
+        # first establishes counter baselines, the second publishes
+        # real EventPortStats samples) — each poll ends in the flush
+        # edge (utilplane scatter, congestion top-k, flight snapshot,
+        # timeline row, devprof memory sampling)
+        if controller.monitor is not None:
+            controller.monitor.poll(now=1000.0)
+            controller.monitor.poll(now=1001.0)
+        for dpid in sorted(controller.topology_manager.topologydb.switches):
+            controller.bus.publish(ev.EventPortStats(
+                dpid, 1, rx_pps=100.0, rx_bps=5e8,
+                tx_pps=200.0, tx_bps=1e9,
+            ))
+        controller.bus.publish(ev.EventStatsFlush())
+
+        # a link flap: delta log, revalidation, route-cache sync
+        links = [
+            link for dst_map in db.links.values()
+            for link in dst_map.values()
+        ]
+        controller.bus.publish(ev.EventLinkDelete(links[0]))
+        controller.bus.publish(ev.EventTopologyChanged())
+        controller.router.flush_routes()
+
+        # admission storm: a rate-overridden tenant bursts past its
+        # bucket — the first burst depth admits (counted), the rest
+        # reject at the door (counted)
+        stormer = macs[-1]
+        controller.router.admission.assign(stormer, "stormer", rate=5.0)
+        h = fabric.hosts[stormer]
+        for _ in range(64):
+            controller.bus.publish(ev.EventPacketIn(
+                h.dpid, h.port_no,
+                of.Packet(eth_src=stormer, eth_dst=macs[0],
+                          payload=b"storm"),
+                of.OFP_NO_BUFFER,
+            ))
+        controller.router.flush_routes()
+        controller.bus.publish(ev.EventStatsFlush())
+        controller.event_logger.close()
+
+
+def run_metrics_lint(readme_path: str = "README.md",
+                     do_soak: bool = True) -> list[str]:
+    """Run the lint; returns the list of violations (empty = pass)."""
+    from sdnmpi_tpu.api.telemetry import (
+        documented_metrics,
+        instrument_rows,
+    )
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    errors: list[str] = []
+    if do_soak:
+        soak()
+    rows = instrument_rows()
+    registered = {r["name"] for r in rows}
+    documented = documented_metrics(
+        pathlib.Path(readme_path).read_text()
+    )
+    if not documented:
+        errors.append(
+            f"{readme_path}: no metrics reference table found "
+            "(README format drift?)"
+        )
+    for r in rows:
+        if r["owner"] == "?":
+            errors.append(
+                f"{r['name']}: no owner prefix in "
+                "api/telemetry.METRIC_OWNERS"
+            )
+    for name in sorted(registered - documented):
+        errors.append(
+            f"{name}: registered but undocumented in the README "
+            "metrics reference table (regenerate with "
+            "`python -m sdnmpi_tpu.api.telemetry --table`)"
+        )
+    for name in sorted(documented - registered):
+        errors.append(
+            f"{name}: documented in the README table but no longer "
+            "registered (stale docs)"
+        )
+    if do_soak:
+        by_name = dict(REGISTRY)
+        for name in sorted(registered):
+            inst = by_name.get(name)
+            if inst is None or _exempt(name):
+                continue
+            if not _moved(inst):
+                errors.append(
+                    f"{name}: never touched by the lint soak — dead "
+                    "metric, or add it to metrics_lint.SOAK_EXEMPT "
+                    "with a category"
+                )
+    return errors
+
+
+def main() -> int:
+    errors = run_metrics_lint()
+    for e in errors:
+        print(f"metrics-lint: {e}")
+    print(f"metrics-lint: {len(errors)} violation(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

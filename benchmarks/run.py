@@ -309,13 +309,29 @@ def main() -> None:
             break
     flags = {a for a in args if a.startswith("--")}
     if unknown_flags := flags - {
-        "--json-schema-check", "--metrics-dump", "--flight-dump"
+        "--json-schema-check", "--metrics-dump", "--flight-dump",
+        "--metrics-lint",
     }:
         # a typo'd flag must not silently launch the full TPU suite
         sys.exit(f"unknown flag(s) {sorted(unknown_flags)}")
     schema_only = "--json-schema-check" in flags
     metrics_dump = "--metrics-dump" in flags
     flight_dump = "--flight-dump" in flags
+    if "--metrics-lint" in flags:
+        # telemetry-plane gate (ISSUE 14, benchmarks/metrics_lint.py):
+        # a short sim soak + registry walk — every metric documented in
+        # the README reference table and alive (or exempt with a
+        # category). No TPU, runs beside --json-schema-check in CI.
+        if len(args) > 1 or gate_path is not None:
+            sys.exit("--metrics-lint runs alone (no config ids or "
+                     "other flags)")
+        from benchmarks.metrics_lint import run_metrics_lint
+
+        errors = run_metrics_lint(str(root / "README.md"))
+        for e in errors:
+            print(f"metrics-lint: {e}", file=sys.stderr)
+        print(f"metrics-lint: {len(errors)} violation(s)")
+        sys.exit(1 if errors else 0)
     gate_rows = _load_gate(gate_path) if gate_path is not None else None
     only = {a for a in args if not a.startswith("--")}
     known = {name for name, _ in CONFIGS}

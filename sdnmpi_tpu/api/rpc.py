@@ -46,6 +46,21 @@ class RPCClient(Protocol):
     def send_json(self, message: dict) -> None: ...
 
 
+def _timeline_names(params):
+    """``timeline`` params -> series-name filter (None = everything).
+    A bare string first param is ONE name, not an iterable of chars —
+    char-splitting it would silently filter every real series out and
+    the empty reply would read as "series does not exist"."""
+    if not params:
+        return None
+    names = params[0]
+    if names is None:
+        return None
+    if isinstance(names, str):
+        return [names]
+    return [str(n) for n in names]
+
+
 class RPCInterface:
     name = "RPCInterface"
 
@@ -168,6 +183,10 @@ class RPCInterface:
     #                           containing that span (exemplar
     #                           resolution), or null
     #   flight_dump()        -> freeze + return a diagnostic bundle NOW
+    #   timeline([names])    -> the metrics timeline's queryable
+    #                           history (ISSUE 14): {series: {name:
+    #                           [[ts, value], ...]}} over the bounded
+    #                           multi-resolution ring; names filters
 
     #: method name -> (request factory, reply-attribute extractor)
     PULL_METHODS = {
@@ -177,6 +196,9 @@ class RPCInterface:
                       lambda reply: reply.tree),
         "flight_dump": (lambda params: ev.FlightDumpRequest(),
                         lambda reply: reply.bundle),
+        "timeline": (lambda params: ev.TimelineRequest(
+                         _timeline_names(params)),
+                     lambda reply: reply.timeline),
     }
 
     def handle_request(self, message: dict):

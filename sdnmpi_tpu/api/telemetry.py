@@ -55,36 +55,52 @@ def _escape_label(value: str) -> str:
     )
 
 
+def _split_label(name: str) -> tuple[str, str]:
+    """``name{key=value}`` -> (sanitized base, rendered ``key="value"``
+    pair); a bare name comes back with an empty pair. The ONE parse of
+    the labeled-instrument naming convention — the counter and
+    histogram render branches must never drift on it. Split on the
+    FIRST '{' and drop only the final '}': the label value itself may
+    contain braces."""
+    if "{" not in name:
+        return _sanitize(name), ""
+    base, label = name.split("{", 1)
+    if label.endswith("}"):
+        label = label[:-1]
+    key, _, val = label.partition("=")
+    return _sanitize(base), f'{key}="{_escape_label(val)}"'
+
+
 def render(snapshot: dict) -> str:
     """Prometheus text exposition of a :func:`telemetry_snapshot` (or a
     bare registry snapshot). Counter names already carrying a label
     (``name{key=value}``) pass through with the label quoted."""
     lines: list[str] = []
     for name, value in snapshot.get("counters", {}).items():
-        if "{" in name:
-            # split on the FIRST '{' and drop only the final '}' — the
-            # label value itself may contain braces
-            base, label = name.split("{", 1)
-            if label.endswith("}"):
-                label = label[:-1]
-            key, _, val = label.partition("=")
-            lines.append(
-                f'{_sanitize(base)}{{{key}="{_escape_label(val)}"}} {value}'
-            )
+        base, extra = _split_label(name)
+        if extra:
+            lines.append(f"{base}{{{extra}}} {value}")
         else:
-            lines.append(f"{_sanitize(name)} {value}")
+            lines.append(f"{base} {value}")
     for name, value in snapshot.get("gauges", {}).items():
         lines.append(f"{_sanitize(name)} {value}")
     for name, h in snapshot.get("histograms", {}).items():
-        name = _sanitize(name)
+        # labeled-histogram children arrive as name{label=value}: the
+        # label rides every series of the child, beside le= on buckets
+        name, extra = _split_label(name)
         cumulative = 0
+        sep = "," if extra else ""
         for bound, count in zip(h["buckets"], h["counts"]):
             cumulative += count
-            lines.append(f'{name}_bucket{{le="{bound}"}} {cumulative}')
+            lines.append(
+                f'{name}_bucket{{{extra}{sep}le="{bound}"}} {cumulative}'
+            )
         cumulative += h["counts"][-1]
-        lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-        lines.append(f"{name}_sum {h['sum']}")
-        lines.append(f"{name}_count {h['count']}")
+        lines.append(f'{name}_bucket{{{extra}{sep}le="+Inf"}} {cumulative}')
+        lines.append(f"{name}_sum{{{extra}}} {h['sum']}" if extra
+                     else f"{name}_sum {h['sum']}")
+        lines.append(f"{name}_count{{{extra}}} {h['count']}" if extra
+                     else f"{name}_count {h['count']}")
     # oracle latency summary flattens to gauges (count/mean/p50/p99/max
     # per op) so scrape tooling sees route-compute latency too
     for op, s in snapshot.get("oracle", {}).items():
@@ -119,3 +135,178 @@ def install_env_dump_hook() -> bool:
         return False
     atexit.register(lambda: dump(path))
     return True
+
+
+# -- metrics reference (ISSUE 14) ------------------------------------------
+#
+# The README's metrics reference table is GENERATED from the live
+# registry (instrument_rows -> metrics_table) and the metrics-lint CI
+# gate (benchmarks/metrics_lint.py) holds the two equal: every
+# registered instrument must appear in the table, every table row must
+# still exist in the registry. Regenerate with:
+#
+#   python -m sdnmpi_tpu.api.telemetry --table
+
+#: every module that registers instruments at import time — imported
+#: before walking the registry so the reference is complete regardless
+#: of which subsystems the current process happened to touch
+INSTRUMENTED_MODULES = (
+    "sdnmpi_tpu.utils.metrics",
+    "sdnmpi_tpu.utils.tracing",
+    "sdnmpi_tpu.utils.flight",
+    "sdnmpi_tpu.utils.event_log",
+    "sdnmpi_tpu.utils.devprof",
+    "sdnmpi_tpu.control.router",
+    "sdnmpi_tpu.control.southbound",
+    "sdnmpi_tpu.control.admission",
+    "sdnmpi_tpu.control.slo",
+    "sdnmpi_tpu.control.recovery",
+    "sdnmpi_tpu.control.monitor",
+    "sdnmpi_tpu.control.topology_manager",
+    "sdnmpi_tpu.control.fabric",
+    "sdnmpi_tpu.oracle.engine",
+    "sdnmpi_tpu.oracle.utilplane",
+    "sdnmpi_tpu.oracle.incremental",
+    "sdnmpi_tpu.oracle.routecache",
+    "sdnmpi_tpu.oracle.hier",
+    "sdnmpi_tpu.shardplane.hier",
+    "sdnmpi_tpu.core.topology_db",
+)
+
+#: name-prefix -> owning subsystem, LONGEST match wins (the table's
+#: "owner" column; a new prefix without an entry surfaces as "?" in
+#: the table, which the lint rejects — so new subsystems must claim
+#: their names here)
+METRIC_OWNERS = (
+    ("admission_", "control/admission"),
+    ("barrier_", "control/recovery"),
+    ("barriers_pending", "control/recovery"),
+    ("desired_flows", "control/recovery"),
+    ("coalescer_", "control/router"),
+    ("compile_cache_", "utils/devprof"),
+    ("congestion_", "control/topology_manager"),
+    ("device_memory_", "utils/devprof"),
+    ("echo_", "control/southbound"),
+    ("event_log_", "utils/event_log"),
+    ("fabric_", "control/fabric"),
+    ("flight_", "utils/flight"),
+    ("hier_", "oracle/hier"),
+    ("install_e2e_", "control/router"),
+    ("install_", "control/recovery"),
+    ("jit_compile_", "utils/devprof"),
+    ("jit_", "utils/tracing"),
+    ("monitor_", "control/monitor"),
+    ("oracle_", "oracle/engine"),
+    ("pipeline_", "control/router"),
+    ("profile_", "utils/devprof"),
+    ("reconcile_", "control/recovery"),
+    ("recovery_", "control/recovery"),
+    ("reval_", "control/router"),
+    ("ring_", "shardplane"),
+    ("route_cache_", "oracle/routecache"),
+    ("router_", "control/router"),
+    ("sched_", "control/router"),
+    ("serving_warmup_", "oracle/engine"),
+    ("shard_", "oracle/engine"),
+    ("slo_", "control/slo"),
+    ("southbound_", "control/southbound"),
+    ("topology_", "core/topology_db"),
+    ("trace_", "utils/tracing"),
+    ("utilplane_", "oracle/utilplane"),
+)
+
+
+def owner_of(name: str) -> str:
+    best = "?"
+    best_len = 0
+    for prefix, owner in METRIC_OWNERS:
+        if name.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = owner, len(prefix)
+    return best
+
+
+def _import_instrumented() -> None:
+    import importlib
+
+    for mod in INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+
+
+def instrument_rows(registry=None) -> list[dict]:
+    """Walk the (fully imported) registry into reference rows:
+    ``{name, kind, label, owner, help}`` sorted by name. Labeled
+    families appear ONCE under their family name — the label column
+    carries the key."""
+    from sdnmpi_tpu.utils.metrics import (
+        Counter,
+        Gauge,
+        Histogram,
+        LabeledCounter,
+        LabeledHistogram,
+    )
+
+    _import_instrumented()
+    if registry is None:
+        registry = REGISTRY
+    kinds = {
+        Counter: "counter",
+        Gauge: "gauge",
+        Histogram: "histogram",
+        LabeledCounter: "counter",
+        LabeledHistogram: "histogram",
+    }
+    rows = []
+    for name, inst in registry:
+        rows.append({
+            "name": name,
+            "kind": kinds.get(type(inst), type(inst).__name__),
+            "label": getattr(inst, "label", "") or "",
+            "owner": owner_of(name),
+            "help": getattr(inst, "help", "") or "",
+        })
+    return rows
+
+
+def metrics_table(registry=None) -> str:
+    """The README's generated metrics reference table (markdown)."""
+    lines = [
+        "| metric | type | labels | owner |",
+        "|---|---|---|---|",
+    ]
+    for r in instrument_rows(registry):
+        lines.append(
+            f"| `{r['name']}` | {r['kind']} | {r['label']} "
+            f"| `{r['owner']}` |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def documented_metrics(readme_text: str) -> set:
+    """Metric names claimed by the README's reference table: the
+    backticked first column of ``| `name` | ...`` rows (the lint's
+    parse side — format drift fails loudly as an empty set)."""
+    import re
+
+    return set(re.findall(r"^\| `([a-z0-9_]+)` \|", readme_text, re.M))
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="sdnmpi_tpu.api.telemetry",
+        description="telemetry tooling",
+    )
+    p.add_argument(
+        "--table", action="store_true",
+        help="print the generated metrics reference table (markdown)",
+    )
+    args = p.parse_args(argv)
+    if args.table:
+        sys.stdout.write(metrics_table())
+    else:
+        dump("-")
+
+
+if __name__ == "__main__":
+    main()

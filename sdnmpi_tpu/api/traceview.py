@@ -63,9 +63,16 @@ def _roots(spans: dict[int, dict]) -> dict[int, int]:
     return root_of
 
 
-def chrome_trace(records: Iterable[dict]) -> dict:
+def chrome_trace(records: Iterable[dict], counters=None) -> dict:
     """Render decoded trace records as a Chrome Trace Event Format
-    object (``{"traceEvents": [...], "displayTimeUnit": "ms"}``)."""
+    object (``{"traceEvents": [...], "displayTimeUnit": "ms"}``).
+
+    ``counters`` adds Perfetto **counter tracks** beside the span
+    slices (ISSUE 14): ``[{"name": ..., "points": [[t_pc, value],
+    ...]}, ...]`` on the same ``perf_counter`` clock span ``t0``/``t1``
+    stamps use (utils/timeline.MetricsTimeline.counter_tracks), so
+    cache-hit-rate, route p99, congestion, and device-memory lines
+    render on the same timeline as the requests they explain."""
     spans = {r["span"]: r for r in records if r.get("kind") == "span"}
     links = [
         (r["span"], r["parent"])
@@ -73,9 +80,30 @@ def chrome_trace(records: Iterable[dict]) -> dict:
         if r.get("kind") == "span_link"
     ]
     events: list[dict] = []
+    if not spans and not counters:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t_candidates = [r["t0"] for r in spans.values()] + [
+        track["points"][0][0]
+        for track in counters or ()
+        if track.get("points")
+    ]
+    if not t_candidates:
+        # counters= given but every track empty-pointed: an empty
+        # trace, not a ValueError from min()
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t_base = min(t_candidates)
+    for track in counters or ():
+        for t_pc, value in track.get("points", ()):
+            events.append({
+                "name": track["name"],
+                "cat": "metric",
+                "ph": "C",
+                "ts": round((t_pc - t_base) * 1e6, 3),
+                "pid": 1,
+                "args": {"value": value},
+            })
     if not spans:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
-    t_base = min(r["t0"] for r in spans.values())
     root_of = _roots(spans)
     # stable per-tree track ids in first-seen order
     tid_of: dict[int, int] = {}
@@ -142,10 +170,12 @@ def chrome_trace(records: Iterable[dict]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def dump_chrome_trace(records: Iterable[dict], path: str) -> dict:
+def dump_chrome_trace(
+    records: Iterable[dict], path: str, counters=None
+) -> dict:
     """Write :func:`chrome_trace` of ``records`` to ``path``; returns
     the trace object."""
-    trace = chrome_trace(records)
+    trace = chrome_trace(records, counters=counters)
     with open(path, "w") as f:
         json.dump(trace, f)
     return trace
@@ -154,7 +184,8 @@ def dump_chrome_trace(records: Iterable[dict], path: str) -> dict:
 class TraceCollector:
     """Bounded in-memory span collector for ``--trace-dump``: a tee'd
     trace sink retaining only span/span_link records (the kinds the
-    timeline renders), dumped once on shutdown."""
+    timeline renders), dumped once on shutdown — with the metrics
+    timeline's counter tracks beside the slices when one is passed."""
 
     def __init__(self, max_records: int = 100_000) -> None:
         import collections
@@ -167,8 +198,13 @@ class TraceCollector:
         if rec.get("kind") in ("span", "span_link"):
             self.records.append(rec)
 
-    def dump(self, path: str) -> dict:
-        return dump_chrome_trace(list(self.records), path)
+    def dump(self, path: str, timeline=None) -> dict:
+        counters = (
+            timeline.counter_tracks() if timeline is not None else None
+        )
+        return dump_chrome_trace(
+            list(self.records), path, counters=counters
+        )
 
 
 def convert(jsonl_path: str, out_path: str) -> dict:

@@ -277,6 +277,51 @@ class Config:
     #: (with compile_cache_dir, from the disk cache)
     warm_serving: bool = False
 
+    # --- SLO plane (control/slo.py; ISSUE 14) -----------------------------
+    #: per-tenant serving objectives: ``{tenant: (p99_ms,
+    #: availability)}`` (CLI: repeatable ``--slo-target
+    #: tenant:p99_ms[:avail]``). Non-empty arms the SLO plane: the
+    #: Router feeds ``slo_route_latency_seconds{tenant=...}`` at window
+    #: completion for targeted tenants, and (with the flight recorder)
+    #: one multi-window burn-rate trigger per tenant freezes a
+    #: diagnostic bundle naming the burning tenant and the dominant
+    #: pipeline stage. Empty (default) costs the Router one is-None
+    #: test per window — the PR-4/7 unarmed contract.
+    slo_targets: dict = dataclasses.field(default_factory=dict)
+    #: burn-rate factor both windows must exceed for the SLO trigger
+    #: to fire (burn 1.0 = spending the error budget exactly on
+    #: schedule; the SRE workbook's fast-window factors are O(10))
+    slo_burn_factor: float = 8.0
+    #: slow-window depth in Monitor flushes (the fast window is always
+    #: the last flush interval): both windows are flush-cadence-
+    #: relative, so the alert scales with the Monitor interval instead
+    #: of assuming wall-clock minutes
+    slo_slow_flushes: int = 12
+
+    # --- metrics timeline (utils/timeline.py; ISSUE 14) -------------------
+    #: keep the bounded multi-resolution ring of compact registry rows
+    #: (one per EventStatsFlush): minutes of queryable metric history
+    #: at bounded memory, served by the ``timeline()`` pull RPC and
+    #: exported as Perfetto counter tracks beside the span slices.
+    #: Distinct from the flight recorder's short trigger-baseline
+    #: window. False drops the per-flush row entirely.
+    metrics_timeline: bool = True
+    #: rows per timeline resolution level (3 levels, decimation 4:
+    #: level 0 holds this many flushes at full cadence, level 2 covers
+    #: 16x the span at 1/16 the resolution)
+    timeline_points: int = 512
+
+    # --- anomaly-armed profiler capture (utils/devprof.py; ISSUE 14) ------
+    #: directory for anomaly-armed ``jax.profiler`` capture windows
+    #: ("" = off): when a flight-recorder trigger fires, the device
+    #: profiler records for ``profile_capture_s`` seconds — the
+    #: profile OF the incident, with zero steady-state overhead
+    #: (--profile-dump DIR)
+    profile_dump_dir: str = ""
+    #: capture-window length in seconds (closed on the next Monitor
+    #: flush past the deadline)
+    profile_capture_s: float = 3.0
+
     #: backpressure cap for batched FlowMod sends: a per-switch burst is
     #: written to the wire in slices of at most this many bytes, with
     #: the stalled-peer write-buffer check re-run between slices — one

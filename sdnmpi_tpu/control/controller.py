@@ -95,6 +95,43 @@ class Controller:
             )
             self.bus.tap(self.event_logger)
 
+        # device-runtime telemetry (ISSUE 14, utils/devprof.py):
+        # compile-wall histograms + persistent-compile-cache hit/miss
+        # counters via jax.monitoring (rare events — no hot-path cost),
+        # and device-memory watermark gauges sampled once per Monitor
+        # flush. Subscribed BEFORE the flight recorder so the trigger
+        # pass (and the timeline row) sees the same pass's fresh sample.
+        from sdnmpi_tpu.utils import devprof
+
+        devprof.install_monitoring()
+        self.bus.subscribe(
+            ev.EventStatsFlush, lambda e: devprof.sample_memory()
+        )
+
+        # SLO plane (ISSUE 14, control/slo.py): per-tenant objectives,
+        # per-tenant latency histograms fed by the Router at window
+        # completion, and one multi-window burn-rate trigger per tenant
+        # registered with the flight recorder below.
+        self.slo = None
+        if config.slo_targets:
+            from sdnmpi_tpu.control.slo import SLOPlane
+
+            self.slo = SLOPlane(
+                config.slo_targets,
+                self.router.admission,
+                burn_factor=config.slo_burn_factor,
+                slow_flushes=config.slo_slow_flushes,
+            )
+            self.router.slo = self.slo
+
+        # anomaly-armed profiler capture (ISSUE 14): a firing trigger
+        # opens a jax.profiler window for profile_capture_s seconds
+        self.profile_capture = None
+        if config.profile_dump_dir:
+            self.profile_capture = devprof.ProfileCapture(
+                config.profile_dump_dir, config.profile_capture_s
+            )
+
         # flight recorder (ISSUE 7): bounded span-tree ring + snapshot
         # window + event tail, with anomaly triggers freezing diagnostic
         # bundles. Wired LAST so its per-EventStatsFlush trigger pass
@@ -111,6 +148,11 @@ class Controller:
             flight = FlightRecorder(
                 max_trees=config.flight_max_trees,
                 dump_dir=config.flight_dump_dir,
+                # the SLO slow window reads the recorder's snapshot
+                # ring: size it to COVER slo_slow_flushes, or a large
+                # configured window would silently truncate to the
+                # ring depth and page noisier than configured
+                max_snapshots=max(32, config.slo_slow_flushes + 1),
             )
             # escalations/timeouts: every increment is an incident
             flight.add_counter_triggers()
@@ -125,6 +167,13 @@ class Controller:
                     ))
             flight.add_context("topology", self._topology_forensics)
             flight.add_context("windows", self.router.window_census)
+            if self.slo is not None:
+                # SLO burn triggers + the bundle context naming the
+                # burning tenant's dominant pipeline stage (ISSUE 14)
+                flight.triggers.extend(self.slo.triggers())
+                flight.add_context(
+                    "slo", lambda: self.slo.forensics(self.flight)
+                )
             flight.on_anomaly = self._publish_anomaly
             flight.arm()
             self.bus.tap(flight.event_tap)
@@ -132,8 +181,36 @@ class Controller:
                 ev.EventStatsFlush, lambda e: flight.snapshot_tick()
             )
             self.flight = flight
+
+        # metrics timeline (ISSUE 14, utils/timeline.py): one compact
+        # row per EventStatsFlush — riding the flight recorder's
+        # snapshot tee when armed (the tick already paid for the
+        # snapshot), its own subscription otherwise.
+        self.timeline = None
+        if config.metrics_timeline:
+            from sdnmpi_tpu.utils.timeline import MetricsTimeline
+
+            self.timeline = MetricsTimeline(
+                maxlen=config.timeline_points
+            )
+            if self.flight is not None:
+                self.flight.on_snapshot = (
+                    lambda ts, snap: self.timeline.tick(snap, ts)
+                )
+            else:
+                self.bus.subscribe(
+                    ev.EventStatsFlush, lambda e: self.timeline.tick()
+                )
+        if self.profile_capture is not None:
+            # close an expired capture window on the flush AFTER the
+            # flight recorder's trigger pass (which may have opened it)
+            self.bus.subscribe(
+                ev.EventStatsFlush,
+                lambda e: self.profile_capture.tick(),
+            )
         self.bus.provide(ev.SpanTreeRequest, self._span_tree)
         self.bus.provide(ev.FlightDumpRequest, self._flight_dump)
+        self.bus.provide(ev.TimelineRequest, self._timeline)
 
     #: the route/install/re-route latency histograms the flight
     #: recorder's latency/p99 triggers watch (ISSUE 7)
@@ -194,6 +271,16 @@ class Controller:
         )
         return ev.FlightDumpReply(bundle)
 
+    def _timeline(self, req) -> "object":
+        from sdnmpi_tpu.control import events as ev
+
+        timeline = (
+            self.timeline.series(req.names)
+            if self.timeline is not None
+            else {"series": {}, "n_rows": 0, "span_s": 0.0}
+        )
+        return ev.TimelineReply(timeline)
+
     def _publish_anomaly(self, bundle: dict) -> None:
         """Flight-recorder anomaly hook -> one EventAnomaly on the bus
         (the RPC mirror broadcasts it). The summary strips the bulky
@@ -206,6 +293,11 @@ class Controller:
             for k, v in bundle.items()
             if k not in ("span_trees", "metrics", "events_tail", "exemplars")
         }
+        if self.profile_capture is not None:
+            # anomaly-armed device profiling (ISSUE 14): the capture
+            # window opens the moment the trigger fires and closes on a
+            # later flush tick — the profile OF the incident
+            self.profile_capture.on_anomaly(bundle)
         self.bus.publish(ev.EventAnomaly(
             bundle["trigger"], summary, bundle.get("path")
         ))

@@ -479,6 +479,24 @@ class FlightDumpReply(Reply):
 
 
 @dataclasses.dataclass
+class TimelineRequest(Request):
+    """The metrics timeline's queryable history (ISSUE 14,
+    utils/timeline.py): ``{series: {name: [[ts, value], ...]}, ...}``
+    over the bounded multi-resolution ring — minutes of per-flush
+    metric history beside the flight recorder's short trigger window.
+    ``names`` filters to specific series (None = everything). Provided
+    by the Controller; the ``timeline()`` pull RPC rides it."""
+
+    names: Optional[list] = None
+    dst = "Controller"
+
+
+@dataclasses.dataclass
+class TimelineReply(Reply):
+    timeline: dict
+
+
+@dataclasses.dataclass
 class CongestionReportRequest(Request):
     """The device-side congestion analytics of the latest Monitor pass
     (ISSUE 7): top-k hot links, per-collective attribution (which

@@ -221,41 +221,67 @@ class LoadGen:
         clock = time.perf_counter if now is None else now
         t0 = clock()
 
+        # SLO plane feed (ISSUE 14): the harness owns the arrival
+        # schedule, so IT measures the latency a tenant experiences —
+        # schedule-anchored lateness, queueing-before-park included
+        # (the open-loop half the Router's park-to-install feed cannot
+        # see). While the run drives, the harness takes OWNERSHIP of
+        # its tenants' feed (slo.harness_feed) so the Router does not
+        # also record a park-to-install sample per served request —
+        # double-counted good observations would halve the burn
+        # fraction. None when the controller has no SLO plane.
+        slo = getattr(self.controller, "slo", None)
+        fed: set = set()
+        if slo is not None:
+            fed = {t.name for t in tenants} - slo.harness_feed
+            slo.harness_feed |= fed
+
         def drain(t_done: float) -> None:
             if outstanding and not router._pending:
                 for name, sched_t in outstanding:
                     lat[name].append(t_done - sched_t)
+                    if slo is not None:
+                        slo.observe(name, t_done - sched_t)
                 outstanding.clear()
 
-        for sched_t, name, dpid, port, pkt in events:
-            if pace:
-                ahead = sched_t - (clock() - t0)
-                if ahead > 0:
-                    # flush whatever is parked before going idle: the
-                    # real fabric's idle edge fires between bursts
-                    if router._pending:
-                        router.flush_routes()
+        try:
+            for sched_t, name, dpid, port, pkt in events:
+                if pace:
+                    ahead = sched_t - (clock() - t0)
+                    if ahead > 0:
+                        # flush whatever is parked before going idle:
+                        # the real fabric's idle edge fires between
+                        # bursts
+                        if router._pending:
+                            router.flush_routes()
+                        drain(clock() - t0)
+                        time.sleep(ahead)
+                rej0 = admission.rejections(name)
+                t_inject = clock() - t0
+                bus.publish(
+                    ev.EventPacketIn(dpid, port, pkt, of.OFP_NO_BUFFER)
+                )
+                t_now = clock() - t0
+                if admission.rejections(name) > rej0:
+                    rejected[name] += 1
+                else:
+                    outstanding.append(
+                        (name, sched_t if pace else t_inject)
+                    )
+                # a high-water flush inside the publish (or the direct
+                # uncoalesced path) completed everything parked so far
+                drain(t_now)
+                if router._pending and (
+                    t_now - sched_t >= self.tick_s or not pace
+                ):
+                    router.flush_routes()
                     drain(clock() - t0)
-                    time.sleep(ahead)
-            rej0 = admission.rejections(name)
-            t_inject = clock() - t0
-            bus.publish(ev.EventPacketIn(dpid, port, pkt, of.OFP_NO_BUFFER))
-            t_now = clock() - t0
-            if admission.rejections(name) > rej0:
-                rejected[name] += 1
-            else:
-                outstanding.append((name, sched_t if pace else t_inject))
-            # a high-water flush inside the publish (or the direct
-            # uncoalesced path) completed everything parked so far
-            drain(t_now)
-            if router._pending and (
-                t_now - sched_t >= self.tick_s or not pace
-            ):
+            if router._pending:
                 router.flush_routes()
-                drain(clock() - t0)
-        if router._pending:
-            router.flush_routes()
-        drain(clock() - t0)
+            drain(clock() - t0)
+        finally:
+            if slo is not None:
+                slo.harness_feed -= fed
         elapsed = max(clock() - t0, 1e-9)
 
         reports = {}

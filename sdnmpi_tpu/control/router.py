@@ -294,6 +294,11 @@ class Router:
         self.admission = AdmissionControl(
             config.admission_rate, config.admission_burst
         )
+        #: SLO plane (ISSUE 14, control/slo.py): set by the Controller
+        #: when Config.slo_targets is non-empty. None (the default)
+        #: keeps the per-window cost at one attribute load + is-None
+        #: test — the PR-4/7 unarmed hot-path contract.
+        self.slo = None
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -862,6 +867,12 @@ class Router:
         _m_install_s.observe(time.perf_counter() - t0)
         _m_routed.inc(int(np.count_nonzero(routable)))
         _m_unroutable.inc(len(batch) - int(np.count_nonzero(routable)))
+        slo = self.slo
+        if slo is not None:
+            # per-tenant park-to-install latency for targeted tenants
+            # (control/slo.py): the window is installed, so this is the
+            # latency the tenant's rank experienced end to end
+            slo.observe_batch(batch, time.monotonic())
         for k, p in enumerate(batch):
             p.span.end(routable=bool(routable[k]))
             if routable[k]:

@@ -44,6 +44,12 @@ _m_hot_collectives = REGISTRY.gauge(
     "installed collectives whose routed blocks ride a current top-k hot "
     "link",
 )
+_m_host_sampled = REGISTRY.gauge(
+    "congestion_host_sampled",
+    "1 when the congestion report is served from the Monitor's host "
+    "samples (Config.hier_oracle skips the dense device UtilPlane), "
+    "0 when the jitted device top-k pass serves it",
+)
 
 
 class TopologyManager:
@@ -428,11 +434,22 @@ class TopologyManager:
         congestion-analytics pass over the published epoch. Before the
         plane is bound (no routing call has built tensors yet) samples
         simply stay staged — the first base-cost evaluation flushes
-        them."""
+        them. Under ``Config.hier_oracle`` there deliberately IS no
+        device plane (the dense [V, V] tensor is the ceiling hier
+        escapes) — the congestion report is served from the same host
+        sample dict the hier composer steers on instead of staying
+        silently empty (ISSUE 14 satellite)."""
         p = self.util_plane
-        if p is not None and p.sync(self.topologydb):
-            p.flush()
-            self._refresh_congestion()
+        if p is not None:
+            if p.sync(self.topologydb):
+                p.flush()
+                self._refresh_congestion()
+        elif (
+            self.config.hier_oracle
+            and self.config.util_plane
+            and self.config.oracle_backend == "jax"
+        ):
+            self._refresh_congestion_host()
 
     def _congestion_report(
         self, req: ev.CongestionReportRequest
@@ -450,6 +467,81 @@ class TopologyManager:
         if p is None or not p.bound:
             return
         hot = p.hot_links(self.config.congestion_topk)
+        _m_host_sampled.set(0.0)
+        self.congestion = self._assemble_congestion(hot, epoch=p.epoch)
+
+    def _refresh_congestion_host(self) -> None:
+        """Congestion analytics under the hierarchical oracle (ISSUE 14
+        satellite): hier deliberately skips the dense device UtilPlane,
+        so the top-k pass runs over the Monitor's HOST sample dict —
+        the exact view the hier composer's border steering consumes —
+        and the report additionally aggregates per POD (the granularity
+        hier routes at). The dict is host-sized (one entry per live
+        directed link), so a host sort is the right tool here; the
+        report shape matches the device path's, plus ``pods`` and
+        ``source`` so consumers can tell which pass served it."""
+        samples = self.link_util
+        if not samples:
+            return
+        import heapq
+
+        db = self.topologydb
+        k = max(1, int(self.config.congestion_topk))
+        # O(E log k) selection, and the dst side resolves only for the
+        # k winners by scanning their OWN switch's link dict — never an
+        # O(E) map rebuild per flush (hier exists for 65k-switch
+        # fabrics; this runs on every Monitor pass)
+        top = heapq.nlargest(k, samples.items(), key=lambda kv: kv[1])
+        hot = [
+            {
+                "src": dpid,
+                "dst": next(
+                    (d for d, link in db.links.get(dpid, {}).items()
+                     if link.src.port_no == port),
+                    -1,
+                ),
+                "port": port, "bps": float(bps),
+            }
+            for (dpid, port), bps in top
+            if bps > 0.0
+        ]
+        # pod aggregation: per-pod egress load (the per-switch sums the
+        # hier steering folds, aggregated one level up), hottest first.
+        # The PodMap is the DB's annotation when the generator emitted
+        # one, else the partitioner map the hier oracle resolved at its
+        # last refresh (discovered fabrics); before any refresh there
+        # is no pod structure yet and the block is skipped.
+        pods: list[dict] = []
+        podmap = getattr(db, "podmap", None)
+        if podmap is None:
+            oracle = getattr(db, "_oracle", None)
+            podmap = getattr(
+                getattr(oracle, "_hier", None), "podmap", None
+            )
+        if podmap is not None:
+            by_pod: dict[int, float] = {}
+            for (dpid, _port), bps in samples.items():
+                pod = podmap.pod_of.get(dpid)
+                if pod is not None and bps > 0.0:
+                    by_pod[pod] = by_pod.get(pod, 0.0) + float(bps)
+            pods = [
+                {"pod": p, "bps": round(v, 3)}
+                for p, v in sorted(by_pod.items(), key=lambda kv: -kv[1])
+            ][:k]
+        _m_host_sampled.set(1.0)
+        report = self._assemble_congestion(hot, epoch=0)
+        report["source"] = "host_samples"
+        if pods:
+            report["pods"] = pods
+        self.congestion = report
+
+    def _assemble_congestion(self, hot: list[dict], epoch: int) -> dict:
+        """Assemble the congestion block from decoded top-k entries:
+        headline gauges, per-collective (and per-phase) attribution
+        through the install-time link index, and the oracle's same-
+        batch discrete/fractional figures. Shared by the device top-k
+        pass and the hier host-sample pass so the two report shapes
+        can never drift."""
         _m_hot_bps.set(hot[0]["bps"] if hot else 0.0)
         colls: list[dict] = []
         if hot:
@@ -486,8 +578,8 @@ class TopologyManager:
             colls.sort(key=lambda c: -c["bps"])
         _m_hot_collectives.set(len(colls))
         oracle = getattr(self.topologydb, "_oracle", None)
-        self.congestion = {
-            "epoch": p.epoch,
+        return {
+            "epoch": epoch,
             "top": hot,
             "collectives": colls,
             "discrete_max": getattr(

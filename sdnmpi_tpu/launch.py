@@ -189,7 +189,26 @@ def config_from_args(args) -> Config:
         warm_serving=getattr(args, "warm_serving", False),
         # the serving-load mode measures the coalesced window pipeline
         coalesce_routes=getattr(args, "tenants", 0) > 0,
+        slo_targets=_slo_targets(getattr(args, "slo_target", None)),
+        profile_dump_dir=getattr(args, "profile_dump", None) or "",
     )
+
+
+def _slo_targets(specs) -> dict:
+    """``--slo-target tenant:p99_ms[:avail]`` specs -> the
+    Config.slo_targets dict; malformed specs fail the launch."""
+    if not specs:
+        return {}
+    from sdnmpi_tpu.control.slo import parse_slo_target
+
+    out = {}
+    for spec in specs:
+        try:
+            t = parse_slo_target(spec)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        out[t.tenant] = (t.p99_ms, t.availability)
+    return out
 
 
 def parse_distributed(spec: str) -> tuple[str, int, int]:
@@ -376,11 +395,17 @@ async def amain(args) -> None:
             if metrics_dump != "-":
                 log.info("metrics exposition written to %s", metrics_dump)
         if trace_collector is not None:
-            trace = trace_collector.dump(config.trace_dump)
+            # counter tracks from the metrics timeline render beside
+            # the span slices (ISSUE 14) — one trace, both stories
+            trace = trace_collector.dump(
+                config.trace_dump, timeline=controller.timeline
+            )
             log.info(
                 "Perfetto trace (%d events) written to %s",
                 len(trace["traceEvents"]), config.trace_dump,
             )
+        if controller.profile_capture is not None:
+            controller.profile_capture.close()
         if controller.flight is not None:
             if controller.flight.bundles:
                 log.info(
@@ -664,6 +689,21 @@ def build_parser() -> argparse.ArgumentParser:
         "--anomaly-p99-factor", type=float, default=0.0, metavar="FACTOR",
         help="freeze a bundle when an interval's estimated p99 exceeds "
         "FACTOR x the rolling baseline (0 = off)",
+    )
+    parser.add_argument(
+        "--slo-target", action="append", metavar="TENANT:P99_MS[:AVAIL]",
+        help="per-tenant serving SLO (repeatable; ISSUE 14): the Router "
+        "feeds the tenant's latency histogram and a multi-window "
+        "burn-rate trigger freezes a diagnostic bundle naming the "
+        "burning tenant and the dominant pipeline stage when the error "
+        "budget burns (e.g. --slo-target victim:50:0.999)",
+    )
+    parser.add_argument(
+        "--profile-dump", metavar="DIR",
+        help="anomaly-armed device profiling: when a flight-recorder "
+        "trigger fires, open a jax.profiler capture window under DIR "
+        "for a few seconds — the profile OF the incident, zero "
+        "steady-state overhead",
     )
     parser.add_argument(
         "--event-log",

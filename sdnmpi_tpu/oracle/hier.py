@@ -100,6 +100,13 @@ _m_rows = REGISTRY.counter(
     "hier_border_rows_total",
     "lazily materialized border-distance plane rows",
 )
+_m_pod_imbalance = REGISTRY.gauge(
+    "hier_pod_imbalance",
+    "padded-over-real cells of the stacked pod blocks (sum of "
+    "bucket-padded s^2 over sum of true pod-size^2): the size-bucket "
+    "padding tax of the current PodMap — 1.0 = every pod exactly "
+    "fills its bucket",
+)
 
 
 @dataclasses.dataclass
@@ -445,6 +452,12 @@ def build_state(
     _build_level2(state, src_g, dst_g, port_g, intra)
     _m_pods.set(state.n_pods)
     _m_borders.set(state.n_borders)
+    real_cells = int((sizes * sizes).sum())
+    if real_cells:
+        padded_cells = sum(
+            len(b.pods) * b.s * b.s for b in state.buckets
+        )
+        _m_pod_imbalance.set(padded_cells / real_cells)
     return state
 
 

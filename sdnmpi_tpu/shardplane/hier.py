@@ -36,6 +36,22 @@ from jax import lax
 from jax.sharding import NamedSharding
 
 from sdnmpi_tpu.shardplane.mesh import P, mesh_axes, mesh_shards, shard_map
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+# ring-exchange stall telemetry (ISSUE 14): the border-plane exchange
+# is the one BLOCKING ring leg of the hier refresh (the level-2 builder
+# cannot proceed without the replicated bytes), so its host-blocked
+# wall is the refresh's exchange stall
+_m_ring_stall = REGISTRY.gauge(
+    "ring_exchange_stall_seconds",
+    "host-blocked wall of the last blocking ring exchange (the hier "
+    "border plane; window/refresh exchanges overlap compute and "
+    "attribute through the shard_exchange span instead)",
+)
+_m_exchange_s = REGISTRY.histogram(
+    "shard_exchange_seconds",
+    help="blocking shardplane exchange wall seconds (ring or gather)",
+)
 
 #: row-chunk of the sweep executors: bounds the gathered [rows, nB, K]
 #: relaxation intermediates on device
@@ -282,12 +298,15 @@ def ring_exchange_border_plane(state) -> dict[int, np.ndarray]:
     builder consumes exactly these bytes for its intra-pod skeleton
     weights; ``tests/test_hier.py`` fences them against the direct
     host slice."""
+    import time
+
     from sdnmpi_tpu.kernels.ring import (
         pack_dist_wire,
         ring_all_gather,
         unpack_dist_wire,
     )
 
+    t0 = time.perf_counter()
     mesh = state.mesh
     out: dict[int, np.ndarray] = {}
     for bi, b in enumerate(state.buckets):
@@ -315,6 +334,9 @@ def ring_exchange_border_plane(state) -> dict[int, np.ndarray]:
         # consumer can mistake them for real border rows
         plane[np.arange(bmax)[None, :] >= counts[:, None]] = np.inf
         out[bi] = plane
+    wall = time.perf_counter() - t0
+    _m_ring_stall.set(wall)
+    _m_exchange_s.observe(wall)
     return out
 
 

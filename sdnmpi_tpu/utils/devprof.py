@@ -1,0 +1,252 @@
+"""Device-runtime telemetry (ISSUE 14): compile walls, persistent-
+compile-cache hit/miss, device-memory watermarks, and an anomaly-armed
+profiler capture window.
+
+The PR-4/7 observability plane watches the *control plane*; this module
+watches the *device runtime underneath it* — the other half of every
+"why was that window slow" question (Kanev et al., *Google-Wide
+Profiling*: always-on low-overhead runtime telemetry, not a profiler
+you attach after the fact):
+
+- **Compile walls** — ``jit_compile_seconds{kernel=...}`` beside the
+  existing ``jit_traces_total{kernel=...}``: jax.monitoring's backend-
+  compile duration events, attributed to the instrumented kernel whose
+  Python body traced last (``tracing.LAST_TRACED`` — jax compiles a
+  computation immediately after tracing it, so the attribution is the
+  enclosing kernel; helper jits compiled on its behalf fold into it).
+  A recompile storm now has a cost, not just a count.
+- **Persistent compile-cache hits/misses** —
+  ``compile_cache_hits_total`` / ``compile_cache_misses_total`` +
+  ``compile_cache_saved_seconds``: the PR-11 warm-start claim
+  ("a restarted controller loads its kernels from disk") becomes
+  observable in production instead of a bench-only number.
+- **Device-memory watermarks** — :func:`sample_memory` reads
+  ``jax.local_devices()`` memory stats into
+  ``device_memory_in_use_bytes`` / ``device_memory_peak_bytes`` gauges
+  once per Monitor flush; backends without per-device stats (CPU) fall
+  back to process RSS (``device_memory_host_fallback = 1``), so the
+  gauges never silently read 0 on the dev loop.
+- **Anomaly-armed profiler window** — :class:`ProfileCapture` opens a
+  ``jax.profiler`` trace for N seconds when a flight-recorder trigger
+  fires (``--profile-dump DIR``): the profile of the incident, captured
+  by the incident, with zero steady-state overhead.
+
+jax.monitoring listeners cannot be detached individually, so
+:func:`install_monitoring` registers exactly once per process
+(idempotent) and the listener bodies are unconditional counter/histogram
+writes — they only run on compile/cache events, which are rare by
+definition. Everything else follows the PR-4 contract: disarmed paths
+cost an attribute load and an is-None test.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+log = logging.getLogger("devprof")
+
+#: compile walls span ~10 ms (tiny helper jits) to minutes (the DAG
+#: engine at pod scale) — wider than the latency buckets
+COMPILE_BUCKETS_S = (
+    0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 60.0, 180.0
+)
+
+_m_compile_s = REGISTRY.labeled_histogram(
+    "jit_compile_seconds", "kernel", COMPILE_BUCKETS_S,
+    "backend compile wall per instrumented kernel (jax.monitoring "
+    "duration events attributed to the last-traced kernel)",
+)
+_m_cache_hits = REGISTRY.counter(
+    "compile_cache_hits_total",
+    "compiled programs loaded from the persistent compile cache",
+)
+_m_cache_misses = REGISTRY.counter(
+    "compile_cache_misses_total",
+    "compile requests the persistent cache could not serve",
+)
+_m_cache_saved = REGISTRY.gauge(
+    "compile_cache_saved_seconds",
+    "cumulative compile wall the persistent cache saved this process",
+)
+_m_mem_in_use = REGISTRY.gauge(
+    "device_memory_in_use_bytes",
+    "bytes in use across local devices (process RSS on the host "
+    "fallback), sampled per Monitor flush",
+)
+_m_mem_peak = REGISTRY.gauge(
+    "device_memory_peak_bytes",
+    "high-watermark bytes across local devices (peak RSS on the host "
+    "fallback)",
+)
+_m_mem_fallback = REGISTRY.gauge(
+    "device_memory_host_fallback",
+    "1 when the memory gauges read process RSS because the backend "
+    "exposes no per-device memory stats (CPU), else 0",
+)
+_m_profile_captures = REGISTRY.counter(
+    "profile_captures_total",
+    "anomaly-armed jax.profiler capture windows opened",
+)
+
+_installed = False
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    # '/jax/core/compile/backend_compile_duration' is the real compile;
+    # trace/lowering durations fold into the kernel's jit_traces count
+    # side instead of double-billing the compile histogram
+    if name.endswith("backend_compile_duration"):
+        from sdnmpi_tpu.utils.tracing import LAST_TRACED
+
+        _m_compile_s.observe(LAST_TRACED[0] or "uninstrumented", secs)
+    elif name.endswith("compile_time_saved_sec"):
+        _m_cache_saved.inc(secs)
+
+
+def _on_event(name: str, **kw) -> None:
+    if name.endswith("cache_hits"):
+        _m_cache_hits.inc()
+    elif name.endswith("cache_misses"):
+        _m_cache_misses.inc()
+
+
+def install_monitoring() -> bool:
+    """Register the jax.monitoring listeners (idempotent — listeners
+    cannot be detached, so exactly one pair per process). Returns True
+    when the listeners are (or already were) installed; False when this
+    jax build has no monitoring module (the knob degrades to a warn)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception as e:  # pragma: no cover - jax-version-dependent
+        log.warning("jax.monitoring unavailable (%s); compile telemetry "
+                    "disabled", e)
+        return False
+    _installed = True
+    return True
+
+
+def sample_memory() -> dict:
+    """Sample device-memory watermarks into the gauges (one pass per
+    Monitor flush). Returns the sampled figures (tests and the timeline
+    read them off the gauges)."""
+    in_use = peak = 0
+    fallback = True
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            fallback = False
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak += int(stats.get(
+                "peak_bytes_in_use", stats.get("bytes_in_use", 0)
+            ))
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    if fallback:
+        in_use, peak = _host_rss()
+    _m_mem_in_use.set(in_use)
+    _m_mem_peak.set(peak)
+    _m_mem_fallback.set(1.0 if fallback else 0.0)
+    return {"in_use": in_use, "peak": peak, "fallback": fallback}
+
+
+def _host_rss() -> tuple[int, int]:
+    """(current RSS, peak RSS) of this process — the CPU-backend twin
+    of the device watermarks, so the dev loop's gauges stay live."""
+    current = peak = 0
+    try:
+        import resource
+
+        # linux reports ru_maxrss in KiB
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # pragma: no cover - platform-dependent
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            current = int(f.read().split()[1]) * 4096
+    except Exception:  # pragma: no cover - platform-dependent
+        current = peak
+    return current, max(peak, current)
+
+
+class ProfileCapture:
+    """Anomaly-armed ``jax.profiler`` capture window (ISSUE 14).
+
+    ``on_anomaly()`` opens a profiler trace under ``dump_dir`` (once —
+    re-triggering while a window is open extends nothing; the window
+    that is already running IS the incident's profile) and ``tick()``
+    closes it after ``seconds``. The Controller calls ``on_anomaly``
+    from the flight recorder's anomaly hook and ``tick`` per
+    EventStatsFlush, so the stop needs no timer thread — at worst the
+    window runs one Monitor interval long. ``close()`` stops an open
+    window at shutdown so the trace file is always flushed."""
+
+    def __init__(self, dump_dir: str, seconds: float = 3.0,
+                 max_captures: int = 4, clock=time.monotonic) -> None:
+        self.dump_dir = dump_dir
+        self.seconds = float(seconds)
+        self.max_captures = int(max_captures)
+        self.clock = clock
+        self.n_captures = 0
+        self._t_open: Optional[float] = None
+
+    @property
+    def active(self) -> bool:
+        return self._t_open is not None
+
+    def on_anomaly(self, bundle: Optional[dict] = None) -> bool:
+        """Open a capture window (no-op while one is open or after
+        ``max_captures`` — a trigger storm must not fill the disk with
+        profiles of the same incident). Returns True when opened."""
+        if self._t_open is not None or self.n_captures >= self.max_captures:
+            return False
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.dump_dir)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            log.warning("profiler capture unavailable (%s)", e)
+            self.n_captures = self.max_captures  # stop retrying
+            return False
+        self._t_open = self.clock()
+        self.n_captures += 1
+        _m_profile_captures.inc()
+        log.info("anomaly profiler capture opened under %s (%.1fs)",
+                 self.dump_dir, self.seconds)
+        return True
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Close the window once ``seconds`` have elapsed (called per
+        EventStatsFlush). Returns True when a window closed."""
+        if self._t_open is None:
+            return False
+        now = self.clock() if now is None else now
+        if now - self._t_open < self.seconds:
+            return False
+        return self.close()
+
+    def close(self) -> bool:
+        if self._t_open is None:
+            return False
+        self._t_open = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # pragma: no cover - backend-dependent
+            return False
+        log.info("anomaly profiler capture written to %s", self.dump_dir)
+        return True

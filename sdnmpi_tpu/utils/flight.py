@@ -67,23 +67,11 @@ RECORDER: Optional["FlightRecorder"] = None
 DUMP_ENV = "SDNMPI_FLIGHT_DUMP"
 
 
-def _estimate_p99(buckets, counts) -> float:
-    """Nearest-rank p99 estimate from per-bucket counts: the upper edge
-    of the bucket holding the 99th-percentile rank (+Inf bucket reports
-    the last finite edge — a lower bound, which is the conservative
-    side for a regression trigger)."""
-    total = sum(counts)
-    if total == 0:
-        return 0.0
-    rank = max(1, -(-99 * total // 100))  # ceil(0.99 n), 1-based
-    run = 0
-    for i, c in enumerate(counts):
-        run += c
-        if run >= rank:
-            return float(buckets[i]) if i < len(buckets) else float(
-                buckets[-1]
-            )
-    return float(buckets[-1])
+# nearest-rank p99 estimate from per-bucket counts; the one definition
+# the triggers, the SLO plane, and the metrics timeline all share
+# (+Inf bucket reports the last finite edge — a lower bound, the
+# conservative side for a regression trigger)
+from sdnmpi_tpu.utils.timeline import estimate_p99 as _estimate_p99  # noqa: E402,E501
 
 
 def _hist_delta(cur: dict, prev: Optional[dict]) -> tuple[list, int]:
@@ -271,6 +259,11 @@ class FlightRecorder:
         #: hook fired per frozen bundle: on_anomaly(bundle) — the
         #: Controller publishes EventAnomaly through it
         self.on_anomaly: Optional[Callable[[dict], None]] = None
+        #: snapshot tee: on_snapshot(ts, snapshot) fired once per
+        #: snapshot_tick with the snapshot the tick already paid for —
+        #: the metrics timeline (utils/timeline.py) rides this instead
+        #: of re-snapshotting the registry per flush
+        self.on_snapshot: Optional[Callable[[float, dict], None]] = None
         #: frozen bundles, newest last (also on disk when dump_dir set)
         self.bundles: collections.deque = collections.deque(maxlen=8)
         self.n_dumped = 0
@@ -448,6 +441,11 @@ class FlightRecorder:
                         self.freeze(trigger.name, detail, snapshot=cur)
                     )
         self._snapshots.append((round(now, 6), cur))
+        if self.on_snapshot is not None:
+            try:
+                self.on_snapshot(now, cur)
+            except Exception:  # a broken tee must not take the
+                pass  # Monitor cadence down with it
         return fired
 
     # -- bundles -----------------------------------------------------------

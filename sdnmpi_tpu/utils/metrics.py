@@ -168,6 +168,58 @@ class LabeledCounter:
         self.values[label_value] += n
 
 
+class LabeledHistogram:
+    """A family of :class:`Histogram` children keyed by one label value
+    (ISSUE 14: ``jit_compile_seconds{kernel=...}``, the SLO plane's
+    ``slo_route_latency_seconds{tenant=...}``).
+
+    ``labels(value)`` hands back the child Histogram, which callers
+    should grab ONCE per label and then observe into directly — the
+    child's ``observe`` is the same bisect-plus-two-adds hot path as an
+    unlabeled histogram. Children surface in the registry snapshot as
+    ``name{label=value}`` histogram entries, so every exporter (text
+    exposition, RPC feed, timeline) renders them without new plumbing.
+    Label cardinality is the caller's contract: label values must be a
+    bounded operator-controlled set (kernel names, configured tenants),
+    never request data.
+    """
+
+    __slots__ = ("name", "help", "label", "buckets", "children", "_armed")
+
+    def __init__(
+        self, name: str, label: str, buckets=LATENCY_BUCKETS_S,
+        help: str = "",
+    ) -> None:
+        self.name = name
+        self.help = help
+        self.label = label
+        self.buckets = tuple(float(b) for b in buckets)
+        self.children: dict[str, Histogram] = {}
+        self._armed = False
+
+    def labels(self, value: str) -> Histogram:
+        """The child histogram for one label value (created on first
+        use; joins exemplar arming like a late registration)."""
+        h = self.children.get(value)
+        if h is None:
+            h = Histogram(
+                f"{self.name}{{{self.label}={value}}}", self.buckets,
+                self.help,
+            )
+            if self._armed:
+                h.arm_exemplars()
+            self.children[value] = h
+        return h
+
+    def observe(self, label_value: str, value: float) -> None:
+        self.labels(label_value).observe(value)
+
+    def arm_exemplars(self) -> None:
+        self._armed = True
+        for h in self.children.values():
+            h.arm_exemplars()
+
+
 class MetricsRegistry:
     """Name -> instrument map with idempotent constructors.
 
@@ -208,7 +260,7 @@ class MetricsRegistry:
             self._exemplars_armed = True
             metrics = list(self._metrics.values())
         for m in metrics:
-            if isinstance(m, Histogram):
+            if isinstance(m, (Histogram, LabeledHistogram)):
                 m.arm_exemplars()
 
     def counter(self, name: str, help: str = "") -> Counter:
@@ -242,6 +294,20 @@ class MetricsRegistry:
             )
         return c
 
+    def labeled_histogram(
+        self, name: str, label: str, buckets=LATENCY_BUCKETS_S,
+        help: str = "",
+    ) -> LabeledHistogram:
+        h = self._get_or_make(name, LabeledHistogram, label, buckets, help)
+        if h.label != label or h.buckets != tuple(float(b) for b in buckets):
+            raise ValueError(
+                f"labeled histogram {name!r} already registered with "
+                f"label {h.label!r} buckets {h.buckets}"
+            )
+        if self._exemplars_armed:
+            h.arm_exemplars()
+        return h
+
     def get(self, name: str) -> Optional[object]:
         return self._metrics.get(name)
 
@@ -274,6 +340,18 @@ class MetricsRegistry:
                 if m.exemplars is not None:
                     h["exemplars"] = list(m.exemplars)
                 histograms[name] = h
+            elif isinstance(m, LabeledHistogram):
+                for key in sorted(m.children):
+                    c = m.children[key]
+                    h = {
+                        "buckets": list(c.bounds),
+                        "counts": list(c.counts),
+                        "sum": c.sum,
+                        "count": c.count,
+                    }
+                    if c.exemplars is not None:
+                        h["exemplars"] = list(c.exemplars)
+                    histograms[c.name] = h
             elif isinstance(m, LabeledCounter):
                 counters.update(
                     {
@@ -303,6 +381,19 @@ class MetricsRegistry:
                 m.count = 0
                 if m.exemplars is not None:
                     m.exemplars = [0] * (len(m.bounds) + 1)
+            elif isinstance(m, LabeledHistogram):
+                # children zero IN PLACE: callers hold child references
+                # per the labels() grab-once contract (SLOPlane._hists,
+                # the devprof compile listener), so dropping the dict
+                # would orphan every cached child — post-reset
+                # observations would land in objects no snapshot or
+                # trigger can see
+                for c in m.children.values():
+                    c.counts = [0] * (len(c.bounds) + 1)
+                    c.sum = 0.0
+                    c.count = 0
+                    if c.exemplars is not None:
+                        c.exemplars = [0] * (len(c.bounds) + 1)
             elif isinstance(m, LabeledCounter):
                 m.values.clear()
 

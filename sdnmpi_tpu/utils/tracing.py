@@ -315,10 +315,20 @@ _JIT_TRACES = REGISTRY.labeled_counter(
 TRACE_COUNTS: collections.Counter = _JIT_TRACES.values
 
 
+#: name of the most recently TRACED instrumented kernel — the compile-
+#: wall attribution slot (utils/devprof.py): jax fires its backend-
+#: compile duration event right after tracing the computation, so the
+#: kernel whose Python body just ran is the one being compiled. A one-
+#: element list like CURRENT_SPAN, written only on traces (rare), read
+#: only by the monitoring listener.
+LAST_TRACED = [""]
+
+
 def count_trace(kernel: str) -> None:
     """Record one jit trace of ``kernel`` (no-op on cached dispatches,
     because the traced Python body never re-runs)."""
     TRACE_COUNTS[kernel] += 1
+    LAST_TRACED[0] = kernel
     trace_event("jit_trace", kernel=kernel)
 
 

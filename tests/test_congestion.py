@@ -1,6 +1,7 @@
 """Load-aware ECMP routing tests."""
 
 import numpy as np
+import pytest
 
 from sdnmpi_tpu.collectives import alltoall_pairs
 from sdnmpi_tpu.oracle.apsp import apsp_distances
@@ -161,3 +162,124 @@ class TestUtilizationMatrix:
         t = tensorize(db)
         util = utilization_matrix(t, {})
         assert util.sum() == 0.0
+
+
+class TestHierHostSampledCongestion:
+    """ISSUE 14 satellite: under Config.hier_oracle the dense device
+    UtilPlane deliberately does not exist — the congestion report must
+    be served from the Monitor's host samples (the view the hier
+    composer steers on) with a pod-aggregated block, instead of staying
+    silently empty."""
+
+    def _stack(self, mesh_devices=0, ring=False):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.topogen import fattree
+
+        spec = fattree(4)
+        fabric = spec.to_fabric()
+        config = Config(
+            enable_monitor=False,
+            hier_oracle=True,
+            mesh_devices=mesh_devices,
+            shard_oracle=mesh_devices > 0,
+            ring_exchange=ring,
+        )
+        controller = Controller(fabric, config)
+        controller.attach()
+        return fabric, controller
+
+    def _drive(self, controller):
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.utils.metrics import REGISTRY
+
+        tm = controller.topology_manager
+        assert tm.util_plane is None  # hier really skips the plane
+        # one routing call first: the hier oracle's refresh resolves
+        # the PodMap the pod aggregation reads (serving order)
+        hosts = sorted(tm.topologydb.hosts)
+        tm.topologydb.find_routes_batch([(hosts[0], hosts[1])])
+        # hottest link: dpid a's port toward some neighbor
+        a = sorted(tm.topologydb.links)[0]
+        port = next(iter(tm.topologydb.links[a].values())).src.port_no
+        controller.bus.publish(
+            ev.EventPortStats(a, port, 0.0, 0.0, 0.0, 5e9)
+        )
+        for s, dst_map in list(tm.topologydb.links.items())[:4]:
+            link = next(iter(dst_map.values()))
+            controller.bus.publish(ev.EventPortStats(
+                s, link.src.port_no, 0.0, 0.0, 0.0, 1e8,
+            ))
+        controller.bus.publish(ev.EventStatsFlush())
+        report = controller.bus.request(
+            ev.CongestionReportRequest()
+        ).report
+        assert report, "hier congestion report is still empty"
+        assert report["source"] == "host_samples"
+        assert report["top"][0]["src"] == a
+        assert report["top"][0]["bps"] == pytest.approx(5e9)
+        assert report["top"][0]["dst"] != -1  # resolved via link table
+        # pod aggregation: the hot pod leads, pods come from the PodMap
+        # the hier oracle resolved (discovered fabric -> partitioner)
+        podmap = (
+            tm.topologydb.podmap
+            or tm.topologydb._oracle._hier.podmap
+        )
+        assert report["pods"]
+        assert report["pods"][0]["pod"] == podmap.pod_of[a]
+        assert REGISTRY.get("congestion_host_sampled").value == 1.0
+        assert REGISTRY.get(
+            "congestion_hot_link_bps"
+        ).value == pytest.approx(5e9)
+        # the telemetry snapshot mirrors the same block
+        snap = controller.telemetry()
+        assert snap["congestion"]["source"] == "host_samples"
+        return report
+
+    def test_hier_serves_host_sampled_report(self):
+        _, controller = self._stack()
+        self._drive(controller)
+
+    def test_hier_with_shard_mesh(self, virtual_mesh):
+        _, controller = self._stack(mesh_devices=8)
+        self._drive(controller)
+
+    def test_hier_with_shard_and_ring(self, virtual_mesh):
+        _, controller = self._stack(mesh_devices=8, ring=True)
+        self._drive(controller)
+
+    def test_dense_path_unchanged(self):
+        """Without hier the device pass still serves the report and the
+        host-sampled marker stays 0."""
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.topogen import fattree
+        from sdnmpi_tpu.utils.metrics import REGISTRY
+
+        spec = fattree(4)
+        fabric = spec.to_fabric()
+        controller = Controller(fabric, Config(enable_monitor=False))
+        controller.attach()
+        tm = controller.topology_manager
+        assert tm.util_plane is not None
+        # stage a sample, then BIND the plane (a balanced routing call
+        # builds the base tensor) so the flush's device pass runs
+        macs = sorted(fabric.hosts)
+        a = sorted(tm.topologydb.links)[0]
+        port = next(iter(tm.topologydb.links[a].values())).src.port_no
+        controller.bus.publish(
+            ev.EventPortStats(a, port, 0.0, 0.0, 0.0, 5e9)
+        )
+        tm.topologydb.find_routes_batch_balanced(
+            [(macs[0], macs[1])], link_util=tm.routing_util(),
+        )
+        controller.bus.publish(
+            ev.EventPortStats(a, port, 0.0, 0.0, 0.0, 5e9)
+        )
+        controller.bus.publish(ev.EventStatsFlush())
+        report = controller.bus.request(
+            ev.CongestionReportRequest()
+        ).report
+        assert report["top"] and "source" not in report
+        assert REGISTRY.get("congestion_host_sampled").value == 0.0

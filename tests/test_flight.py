@@ -790,3 +790,108 @@ def test_env_dump_hook(tmp_path, monkeypatch):
     assert not flight.install_env_dump_hook()
     monkeypatch.setenv(flight.DUMP_ENV, str(tmp_path / "f.json"))
     assert flight.install_env_dump_hook()
+
+
+# -- span parentage + bundles across the oracle matrix (ISSUE 14) ----------
+
+
+def _matrix_stack(hier: bool, shard: bool, ring: bool):
+    """A live coalescing controller on a fat-tree under one cell of the
+    hier_oracle/shard_oracle/ring_exchange matrix."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(4)
+    fabric = spec.to_fabric()
+    config = Config(
+        enable_monitor=False,
+        coalesce_routes=True,
+        coalesce_window_s=10.0,
+        hier_oracle=hier,
+        mesh_devices=8 if (shard or ring) else 0,
+        shard_oracle=shard,
+        ring_exchange=ring,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    if shard and not hier:
+        # CPU-cheap twins would chase small windows on host; force the
+        # device leg so the sharded span actually dispatches
+        controller.topology_manager.topologydb._jax_oracle().\
+            host_chase_hop_budget = 0
+    return fabric, controller
+
+
+@pytest.mark.parametrize(
+    "hier,shard,ring",
+    [
+        (True, False, False),
+        (False, True, False),
+        (False, True, True),
+        (True, True, False),
+        (True, True, True),
+    ],
+    ids=["hier", "shard", "shard+ring", "hier+shard", "hier+shard+ring"],
+)
+def test_span_parentage_and_bundle_across_oracle_matrix(
+    hier, shard, ring, virtual_mesh
+):
+    """Satellite (ISSUE 14): the tracing tests pin the dense and
+    sharded legs; this pins the WHOLE matrix — every cell's coalesced
+    window produces one packet_in-rooted tree with route_window ->
+    dispatch/install parentage intact (the sharded cells additionally
+    nest shard_dispatch under the window's dispatch), and a frozen
+    bundle carries those trees plus the forensic contexts."""
+    fabric, controller = _matrix_stack(hier, shard, ring)
+    macs = sorted(fabric.hosts)
+    for i in range(4):
+        src, dst = macs[i], macs[(i + 5) % len(macs)]
+        h = fabric.hosts[src]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=src, eth_dst=dst, payload=b"mx"),
+            of.OFP_NO_BUFFER,
+        ))
+    controller.router.flush_routes()
+    bundle = controller.flight.freeze("manual", {})
+
+    trees = bundle["span_trees"]
+    assert trees, "no completed span trees in the bundle"
+    # parentage: a packet_in root owns a route_window child which owns
+    # dispatch and install stages
+    by_name: dict = {}
+    ok = False
+    for tree in trees:
+        nodes = tree["nodes"]
+        roots = [n for n in nodes.values()
+                 if n["name"] == "packet_in" and not n.get("parent")]
+        for root in roots:
+            for cid in root["children"]:
+                win = nodes.get(cid)
+                if win is None or win["name"] != "route_window":
+                    continue
+                kid_names = {
+                    nodes[k]["name"] for k in win["children"]
+                    if k in nodes
+                }
+                if {"dispatch", "install"} <= kid_names:
+                    ok = True
+                    by_name = nodes
+    assert ok, [
+        sorted({n['name'] for n in t['nodes'].values()}) for t in trees
+    ]
+    if shard and not hier:
+        # the sharded window leg nests shard_dispatch under dispatch
+        names = {n["name"] for n in by_name.values()}
+        assert "shard_dispatch" in names, names
+        sd = next(n for n in by_name.values()
+                  if n["name"] == "shard_dispatch")
+        assert by_name[sd["parent"]]["name"] == "dispatch"
+    # forensic contexts ride every cell's bundle
+    assert "topology" in bundle and "windows" in bundle
+    assert bundle["windows"]["pending_routes"] == 0
+    # exemplars (armed by the recorder) resolve into retained trees
+    e2e = bundle["metrics"]["histograms"]["install_e2e_seconds"]
+    sids = [s for s in e2e.get("exemplars", []) if s]
+    assert sids and any(
+        controller.flight.tree_for(s) is not None for s in sids
+    )

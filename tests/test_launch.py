@@ -426,3 +426,41 @@ class TestServingFlags:
             compile_cache_dir=str(tmp_path / "cc"), duration=0.2,
         )))
         assert (tmp_path / "cc").is_dir()
+
+
+class TestObservabilityFlags:
+    """--slo-target / --profile-dump (ISSUE 14)."""
+
+    def test_defaults_leave_the_planes_dark(self):
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.slo_targets == {}
+        assert cfg.profile_dump_dir == ""
+        assert cfg.metrics_timeline is True  # timeline is always-on
+
+    def test_slo_targets_repeatable(self):
+        cfg = launch.config_from_args(_parse([
+            "--slo-target", "victim:50:0.99",
+            "--slo-target", "gold:10",
+        ]))
+        assert cfg.slo_targets == {
+            "victim": (50.0, 0.99),
+            "gold": (10.0, 0.999),
+        }
+
+    def test_malformed_slo_target_fails_the_launch(self):
+        import pytest
+
+        with pytest.raises(SystemExit):
+            launch.config_from_args(_parse([
+                "--slo-target", "victim",
+            ]))
+        with pytest.raises(SystemExit):
+            launch.config_from_args(_parse([
+                "--slo-target", "victim:50:2.0",
+            ]))
+
+    def test_profile_dump_maps_to_config(self):
+        cfg = launch.config_from_args(_parse([
+            "--profile-dump", "/tmp/prof",
+        ]))
+        assert cfg.profile_dump_dir == "/tmp/prof"

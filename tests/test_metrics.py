@@ -51,6 +51,28 @@ class TestInstruments:
         f.inc("b", 3)
         assert f.values["a"] == 2 and f.values["b"] == 3
 
+    def test_labeled_histogram_children(self):
+        from sdnmpi_tpu.utils.metrics import LabeledHistogram
+
+        f = LabeledHistogram("lh_seconds", "tenant",
+                             buckets=(1.0, 10.0))
+        a = f.labels("a")
+        assert f.labels("a") is a  # stable child identity
+        a.observe(0.5)
+        f.observe("b", 5.0)
+        assert a.count == 1
+        assert f.children["b"].counts == [0, 1, 0]
+        assert f.children["b"].name == "lh_seconds{tenant=b}"
+
+    def test_labeled_histogram_exemplar_arming_covers_new_children(self):
+        from sdnmpi_tpu.utils.metrics import LabeledHistogram
+
+        f = LabeledHistogram("lh2_seconds", "tenant")
+        pre = f.labels("pre")
+        f.arm_exemplars()
+        assert pre.exemplars is not None
+        assert f.labels("post").exemplars is not None
+
 
 class TestRegistry:
     def test_idempotent_registration(self):
@@ -109,6 +131,41 @@ class TestRegistry:
         r.reset()
         assert c.value == 0 and r.counter("c_total") is c
         assert h.counts == [0, 0] and h.count == 0 and h.sum == 0.0
+
+    def test_labeled_histogram_registry_round_trip(self):
+        r = MetricsRegistry()
+        f = r.labeled_histogram("lh_seconds", "tenant",
+                                buckets=(0.1, 1.0))
+        assert r.labeled_histogram(
+            "lh_seconds", "tenant", buckets=(0.1, 1.0)
+        ) is f
+        with pytest.raises(ValueError):
+            r.labeled_histogram("lh_seconds", "kernel",
+                                buckets=(0.1, 1.0))
+        f.observe("a", 0.5)
+        snap = r.snapshot()
+        assert snap["histograms"]["lh_seconds{tenant=a}"]["counts"] == (
+            [0, 1, 0]
+        )
+        json.dumps(snap)
+        # registry-wide exemplar arming reaches children, current and
+        # future (the flight recorder's arm path)
+        r.arm_exemplars()
+        assert f.labels("a").exemplars is not None
+        assert f.labels("new").exemplars is not None
+        # reset zeroes children IN PLACE: callers hold child references
+        # per the grab-once contract, so identity must survive
+        child = f.labels("a")
+        r.reset()
+        assert f.labels("a") is child
+        assert child.count == 0 and child.counts == [0, 0, 0]
+        child.observe(0.5)  # a post-reset observation is still visible
+        assert r.snapshot()["histograms"][
+            "lh_seconds{tenant=a}"
+        ]["count"] == 1
+        assert r.labeled_histogram(
+            "lh_seconds", "tenant", buckets=(0.1, 1.0)
+        ) is f
 
 
 class TestHotPathOverhead:
@@ -206,6 +263,21 @@ class TestExposition:
         assert "oracle_routes_batch_count 4" in text
         assert "oracle_routes_batch_p99_ms 1.25" in text
 
+    def test_labeled_histogram_renders_with_label(self):
+        """A labeled-histogram child (name{label=value}) renders its
+        label beside le= on buckets and on its _sum/_count series."""
+        from sdnmpi_tpu.api.telemetry import render
+
+        r = MetricsRegistry()
+        f = r.labeled_histogram("slo_seconds", "tenant",
+                                buckets=(0.1, 1.0))
+        f.observe("gold", 0.05)
+        f.observe("gold", 5.0)
+        lines = set(render(r.snapshot()).splitlines())
+        assert 'slo_seconds_bucket{tenant="gold",le="0.1"} 1' in lines
+        assert 'slo_seconds_bucket{tenant="gold",le="+Inf"} 2' in lines
+        assert 'slo_seconds_count{tenant="gold"} 2' in lines
+
     def test_label_values_escaped(self):
         """A hostile label value (quotes, backslashes, braces) must not
         produce an exposition the Prometheus parser rejects wholesale."""
@@ -286,6 +358,10 @@ class TestOneRegistryContract:
                 continue  # labeled form asserted in TestExposition
             assert f"{name} {value}" in text
         for name, h in snap["histograms"].items():
+            if "{" in name:
+                # labeled children render label-beside-le form,
+                # asserted in TestExposition
+                continue
             assert f"{name}_count {h['count']}" in text
         # and both agree with a fresh read of the one live registry on
         # every counter that cannot move between flush and re-read

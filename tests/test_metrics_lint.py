@@ -1,0 +1,83 @@
+"""Metrics-lint CI gate tests (ISSUE 14 satellite,
+benchmarks/metrics_lint.py): the short sim soak + registry walk that
+holds the README metrics reference table equal to the live registry
+and rejects dead instruments."""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    REGISTRY.reset()
+
+
+class TestParsers:
+    def test_documented_metrics_parses_table_rows(self):
+        from sdnmpi_tpu.api.telemetry import documented_metrics
+
+        text = (
+            "| metric | type | labels | owner |\n"
+            "|---|---|---|---|\n"
+            "| `a_total` | counter |  | `x` |\n"
+            "| `b_seconds` | histogram | tenant | `y` |\n"
+            "not a row `c_total`\n"
+        )
+        assert documented_metrics(text) == {"a_total", "b_seconds"}
+
+    def test_owner_longest_prefix_wins(self):
+        from sdnmpi_tpu.api.telemetry import owner_of
+
+        assert owner_of("jit_traces_total") == "utils/tracing"
+        assert owner_of("jit_compile_seconds") == "utils/devprof"
+        assert owner_of("install_e2e_seconds") == "control/router"
+        assert owner_of("install_resyncs_total") == "control/recovery"
+        assert owner_of("no_such_prefix") == "?"
+
+    def test_instrument_rows_cover_registry(self):
+        from sdnmpi_tpu.api.telemetry import instrument_rows
+
+        rows = instrument_rows()
+        names = {r["name"] for r in rows}
+        assert "install_e2e_seconds" in names
+        assert "slo_route_latency_seconds" in names
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["slo_route_latency_seconds"]["label"] == "tenant"
+        assert by_name["jit_compile_seconds"]["kind"] == "histogram"
+
+
+class TestLintGate:
+    def test_doc_side_catches_drift(self, tmp_path):
+        """A README missing one registered metric (and carrying one
+        stale row) fails on exactly those names."""
+        from benchmarks.metrics_lint import run_metrics_lint
+        from sdnmpi_tpu.api.telemetry import metrics_table
+
+        table = metrics_table()
+        lines = [
+            ln for ln in table.splitlines()
+            if "`install_e2e_seconds`" not in ln
+        ]
+        lines.append("| `ghost_metric_total` | counter |  | `x` |")
+        readme = tmp_path / "README.md"
+        readme.write_text("\n".join(lines) + "\n")
+        errors = run_metrics_lint(str(readme), do_soak=False)
+        assert any("install_e2e_seconds" in e for e in errors)
+        assert any("ghost_metric_total" in e for e in errors)
+
+    def test_full_gate_passes_on_the_committed_readme(self):
+        """The acceptance run: soak + walk against the repo's README —
+        zero violations (this IS the CI gate,
+        ``python -m benchmarks.run --metrics-lint``)."""
+        from benchmarks.metrics_lint import run_metrics_lint
+
+        errors = run_metrics_lint(str(ROOT / "README.md"), do_soak=True)
+        assert errors == []

@@ -324,6 +324,12 @@ class TestWarmServing:
         assert out["shapes"] == [8, 104]  # bucket-rounded
         assert out["max_len"] >= 8 and out["max_len"] % 8 == 0
         assert out["warm_s"] > 0
+        # warmup telemetry (ISSUE 14 satellite): the wall is a gauge
+        from sdnmpi_tpu.utils.metrics import REGISTRY
+
+        assert REGISTRY.get(
+            "serving_warmup_seconds"
+        ).value == pytest.approx(out["warm_s"])
         # the warmed path serves immediately
         macs = sorted(db.hosts)
         wr = db.find_routes_batch_dispatch([(macs[0], macs[-1])]).reap()
@@ -444,6 +450,15 @@ class TestConfig14Machinery:
         assert warm["served"] and cold["served"]
         assert warm_ms < 5000.0
         assert warm["route_ms"] < 1000.0
+        # warm-start telemetry (ISSUE 14 satellite): the claim is now
+        # observable — the cold child pays compile-cache misses, the
+        # warm child loads from disk (hits), and both record the
+        # warmup wall in the serving_warmup_seconds gauge
+        assert cold["cache_misses"] > 0
+        assert warm["cache_hits"] > 0
+        assert warm["cache_hits"] > warm["cache_misses"]
+        assert cold["warmup_gauge_s"] > 0
+        assert warm["warmup_gauge_s"] > 0
 
 
 class TestWfqCoalescer:

@@ -1,0 +1,488 @@
+"""SLO plane tests (ISSUE 14): targets, burn-rate semantics, the
+Router's per-tenant latency feed, hot-path bounds, and the end-to-end
+acceptance soak — a seeded aggressor storm against a live wire-mode
+controller fires the burn-rate trigger and the frozen bundle names the
+burning tenant and the dominant stage; with admission on, no trigger
+fires; one Perfetto export from the same run carries span slices AND
+counter tracks."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.slo import (
+    LATENCY_HIST,
+    SLOBurn,
+    SLOPlane,
+    SLOTarget,
+    dominant_stage,
+    parse_slo_target,
+)
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    REGISTRY.reset()
+
+
+# -- targets ---------------------------------------------------------------
+
+
+class TestTargets:
+    def test_parse_full(self):
+        t = parse_slo_target("victim:50:0.99")
+        assert t == SLOTarget("victim", 50.0, 0.99)
+
+    def test_parse_default_availability(self):
+        assert parse_slo_target("t0:25").availability == 0.999
+
+    @pytest.mark.parametrize("spec", ["", "t0", ":50", "t0:0",
+                                      "t0:50:1.5", "t0:50:0"])
+    def test_parse_rejects_malformed(self, spec):
+        with pytest.raises(ValueError):
+            parse_slo_target(spec)
+
+    def test_plane_accepts_config_dict_and_specs(self):
+        class _Adm:
+            def tenant_of(self, mac):
+                return mac
+
+        p1 = SLOPlane({"a": (50.0, 0.99)}, _Adm())
+        p2 = SLOPlane(["a:50:0.99"], _Adm())
+        assert p1.targets == p2.targets
+
+
+# -- burn-rate trigger semantics -------------------------------------------
+
+
+def _snap_for(tenant, counts, rejected=0, buckets=None):
+    """A minimal registry-snapshot shape for one tenant's state."""
+    from sdnmpi_tpu.utils.metrics import LATENCY_BUCKETS_S
+
+    buckets = list(buckets or LATENCY_BUCKETS_S)
+    return {
+        "counters": {
+            f"admission_rejections_total{{tenant={tenant}}}": rejected,
+        },
+        "histograms": {
+            f"{LATENCY_HIST}{{tenant={tenant}}}": {
+                "buckets": buckets,
+                "counts": list(counts),
+                "sum": 0.0,
+                "count": sum(counts),
+            },
+        },
+    }
+
+
+class TestSLOBurn:
+    """Interval semantics on hand-built snapshots. Bucket layout
+    (LATENCY_BUCKETS_S): lower edge of the 0.1s bucket is 0.03 — a
+    50 ms target counts observations from the 0.1 bucket up as
+    provably bad (the HistogramThreshold rule)."""
+
+    TARGET = SLOTarget("t0", 50.0, 0.99)
+
+    def test_fires_on_sustained_latency_burn(self):
+        base = _snap_for("t0", [0] * 11)
+        # 100 served, 40 provably over 50 ms -> burn 40x the 1% budget
+        cur = _snap_for("t0", [60, 0, 0, 0, 0, 0, 20, 10, 10, 0, 0])
+        d = SLOBurn(self.TARGET, burn_factor=8.0).check(
+            base, cur, [(0.0, base)]
+        )
+        assert d is not None
+        assert d["tenant"] == "t0"
+        assert d["slo"] == "latency"
+        assert d["burn_fast"] >= 8.0 and d["burn_slow"] >= 8.0
+
+    def test_quiet_tenant_never_fires(self):
+        base = _snap_for("t0", [0] * 11)
+        cur = _snap_for("t0", [100, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0])
+        assert SLOBurn(self.TARGET).check(base, cur, [(0.0, base)]) is None
+
+    def test_min_count_guards_lone_outlier(self):
+        base = _snap_for("t0", [0] * 11)
+        cur = _snap_for("t0", [0, 0, 0, 0, 0, 0, 0, 0, 2, 0, 0])
+        # 2 slow observations of 2 total: burn 100x but n < min_count
+        assert SLOBurn(self.TARGET).check(base, cur, [(0.0, base)]) is None
+
+    def test_slow_window_vetoes_a_blip(self):
+        """Fast window burns but the slow window (which saw a long
+        healthy history accumulate) does not -> no page (the
+        multi-window point)."""
+        # 13 snapshots of healthy traffic accruing 1000 good
+        # observations per flush, then one interval with 30 bad
+        window = [
+            (float(i), _snap_for("t0", [1000 * (i + 1)] + [0] * 10))
+            for i in range(13)
+        ]
+        prev = window[-1][1]
+        cur = _snap_for("t0", [13000, 0, 0, 0, 0, 0, 0, 30, 0, 0, 0])
+        # fast interval: 30 bad of 30 -> burn 100x; slow window (12
+        # flushes back): 30 bad of ~12030 -> burn ~0.25 -> vetoed
+        trigger = SLOBurn(self.TARGET, burn_factor=8.0, slow_flushes=12)
+        assert trigger.check(prev, cur, window) is None
+        # sanity: without the slow-window veto the fast burn alone
+        # would have fired
+        fired = SLOBurn(self.TARGET, burn_factor=8.0, slow_flushes=1)
+        assert fired.check(prev, cur, window) is not None
+
+    def test_availability_burn_fires_on_rejection_storm(self):
+        base = _snap_for("t0", [0] * 11, rejected=0)
+        # 50 served, 50 rejected: 50% unavailability vs 0.1% budget
+        cur = _snap_for("t0", [50] + [0] * 10, rejected=50)
+        d = SLOBurn(self.TARGET, burn_factor=8.0).check(
+            base, cur, [(0.0, base)]
+        )
+        assert d is not None and d["slo"] == "availability"
+
+    def test_name_carries_tenant(self):
+        assert SLOBurn(self.TARGET).name == "slo:t0"
+
+    def test_target_past_top_bucket_cannot_prove_a_latency_breach(self):
+        """Review regression: a target beyond the histogram's last
+        finite edge must NOT clamp — +Inf-bucket observations below
+        the target would count as provably bad and page on a healthy
+        tenant. (SLOPlane warns at construction instead; availability
+        burn still fires.)"""
+        target = SLOTarget("t0", 10_000.0, 0.99)  # 10 s, top bucket 5 s
+        base = _snap_for("t0", [0] * 11)
+        # 100 requests at ~6 s: within the 10 s objective, but the
+        # histogram can only say "> 5 s"
+        cur = _snap_for("t0", [0] * 10 + [100])
+        assert SLOBurn(target).check(base, cur, [(0.0, base)]) is None
+        # a rejection storm still fires through the availability side
+        cur2 = _snap_for("t0", [0] * 10 + [100], rejected=100)
+        d = SLOBurn(target).check(base, cur2, [(0.0, base)])
+        assert d is not None and d["slo"] == "availability"
+
+
+class TestDominantStage:
+    def test_self_time_attribution(self):
+        trees = [{
+            "root": 1,
+            "nodes": {
+                1: {"name": "packet_in", "wall_ms": 10.0,
+                    "children": [2], "links": []},
+                2: {"name": "route_window", "wall_ms": 9.0,
+                    "children": [3, 4], "links": []},
+                3: {"name": "dispatch", "wall_ms": 1.0,
+                    "children": [], "links": []},
+                4: {"name": "reap", "wall_ms": 7.0,
+                    "children": [], "links": []},
+            },
+        }]
+        out = dominant_stage(trees)
+        assert out["dominant_stage"] == "reap"
+        assert out["stage_self_ms"]["route_window"] == 1.0
+
+    def test_empty(self):
+        assert dominant_stage([]) == {
+            "dominant_stage": None, "stage_self_ms": {},
+        }
+
+
+# -- router feed -----------------------------------------------------------
+
+
+def _mini_stack(slo_targets=None, **cfg):
+    from sdnmpi_tpu.topogen import linear
+
+    spec = linear(4)
+    fabric = spec.to_fabric()
+    config = Config(
+        enable_monitor=False, coalesce_routes=True,
+        coalesce_window_s=10.0, slo_targets=slo_targets or {}, **cfg,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    return fabric, controller
+
+
+class TestRouterFeed:
+    def test_unarmed_by_default(self):
+        fabric, controller = _mini_stack()
+        assert controller.router.slo is None
+        macs = sorted(fabric.hosts)
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"x"),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        snap = REGISTRY.snapshot()
+        # no observation lands anywhere in the family (children zeroed
+        # by earlier tests' registry reset may linger, at count 0)
+        assert not any(
+            h["count"]
+            for name, h in snap["histograms"].items()
+            if LATENCY_HIST in name
+        )
+
+    def test_targeted_tenant_observed_untargeted_not(self):
+        fabric, controller = _mini_stack(
+            slo_targets={"gold": (50.0, 0.999)}
+        )
+        macs = sorted(fabric.hosts)
+        controller.router.admission.assign(macs[0], "gold")
+        controller.router.admission.assign(macs[2], "bronze")
+        for src, dst in ((macs[0], macs[1]), (macs[2], macs[3])):
+            h = fabric.hosts[src]
+            controller.bus.publish(ev.EventPacketIn(
+                h.dpid, h.port_no,
+                of.Packet(eth_src=src, eth_dst=dst, payload=b"x"),
+                of.OFP_NO_BUFFER,
+            ))
+        controller.router.flush_routes()
+        hists = REGISTRY.snapshot()["histograms"]
+        gold = hists.get(f"{LATENCY_HIST}{{tenant=gold}}")
+        assert gold is not None and gold["count"] >= 1
+        assert f"{LATENCY_HIST}{{tenant=bronze}}" not in hists
+
+    def test_harness_feed_suppresses_router_double_count(self):
+        """Review regression: while a load harness owns a tenant's feed
+        (slo.harness_feed), the Router's park-to-install observation
+        must NOT also record the same served request — double-counted
+        good observations halve the burn fraction."""
+        fabric, controller = _mini_stack(
+            slo_targets={"gold": (50.0, 0.999)}
+        )
+        macs = sorted(fabric.hosts)
+        controller.router.admission.assign(macs[0], "gold")
+        controller.slo.harness_feed.add("gold")
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"x"),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        hists = REGISTRY.snapshot()["histograms"]
+        gold = hists.get(f"{LATENCY_HIST}{{tenant=gold}}")
+        assert gold is None or gold["count"] == 0
+        # released ownership: the Router feed resumes
+        controller.slo.harness_feed.discard("gold")
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"y"),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        hists = REGISTRY.snapshot()["histograms"]
+        assert hists[f"{LATENCY_HIST}{{tenant=gold}}"]["count"] == 1
+
+    def test_triggers_registered_with_flight(self):
+        _, controller = _mini_stack(
+            slo_targets={"a": (50.0, 0.999), "b": (25.0, 0.99)}
+        )
+        names = {t.name for t in controller.flight.triggers}
+        assert {"slo:a", "slo:b"} <= names
+        assert "slo" in controller.flight.context
+
+
+# -- hot-path bounds (the PR-4/7 contract) ---------------------------------
+
+
+class TestOverheadBounds:
+    N = 200_000
+
+    def test_unarmed_cost_is_attribute_load(self):
+        """The disarmed per-window cost: one attribute load + is-None
+        test, bounded against a bare statement (PR-4 idiom)."""
+        import timeit
+
+        plain = timeit.timeit("x += 1", setup="x = 0", number=self.N)
+        gated = timeit.timeit(
+            "x += 1\n"
+            "s = r.slo\n"
+            "if s is not None:\n"
+            "    raise AssertionError",
+            setup=(
+                "x = 0\n"
+                "class R: slo = None\n"
+                "r = R()"
+            ),
+            number=self.N,
+        )
+        assert gated < plain * 12 + 0.25
+
+    def test_armed_observe_allocates_nothing(self):
+        """The armed path: one labeled-child observe per targeted
+        packet — no retained allocation across a large burst
+        (tracemalloc, the PR-4/7 idiom)."""
+        import tracemalloc
+
+        class _Adm:
+            def tenant_of(self, mac):
+                return "t0"
+
+        class _P:
+            __slots__ = ("src", "t_parked")
+
+            def __init__(self):
+                self.src = "00:00:00:00:00:01"
+                self.t_parked = 1.0
+
+        plane = SLOPlane({"t0": (50.0, 0.999)}, _Adm())
+        batch = [_P() for _ in range(64)]
+        plane.observe_batch(batch, 2.0)  # warm lazy structures
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for _ in range(200):
+            plane.observe_batch(batch, 2.0)
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        retained = sum(
+            s.size_diff for s in after.compare_to(before, "filename")
+            if s.size_diff > 0
+        )
+        assert retained < 64 * 1024, f"retained {retained} bytes"
+
+
+# -- end-to-end acceptance soak --------------------------------------------
+
+
+VICTIM_TARGET_MS = 50.0
+
+
+def _serving_stack(admission_rate: float):
+    """Config-14 posture: live wire-mode controller on a fat-tree,
+    coalesced windows, reactive MPI routing, SLO target on the victim
+    tenant, flight recorder + timeline on (defaults)."""
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(4)
+    fabric = spec.to_fabric(wire=True)
+    config = Config(
+        enable_monitor=False,
+        coalesce_routes=True,
+        coalesce_window_s=10.0,
+        proactive_collectives=False,
+        # every aggressor pair pays the real dispatch path: the memo
+        # would otherwise absorb the storm (56 distinct pairs cycled)
+        # and the victim would never queue
+        route_cache=False,
+        admission_rate=admission_rate,
+        admission_burst=16.0,
+        slo_targets={"victim": (VICTIM_TARGET_MS, 0.999)},
+        slo_burn_factor=8.0,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    return fabric, controller
+
+
+def _run_storm(admission_rate: float, trace_sink=None):
+    """Victim trickle vs seeded aggressor alltoall storm (the PR-11
+    loadgen), bracketed by EventStatsFlush ticks so the SLO trigger
+    pass sees the storm as one fast interval."""
+    from sdnmpi_tpu.control.loadgen import (
+        LoadGen,
+        TenantSpec,
+        register_ranks,
+    )
+    from sdnmpi_tpu.utils.tracing import add_trace_sink, remove_trace_sink
+
+    fabric, controller = _serving_stack(admission_rate)
+    if trace_sink is not None:
+        add_trace_sink(trace_sink)
+    try:
+        macs = sorted(fabric.hosts)
+        vic, agg = macs[:4], macs[4:12]
+        for mac in vic:
+            controller.router.admission.assign(mac, "victim")
+        for mac in agg:
+            controller.router.admission.assign(mac, "aggressor")
+        ranks = register_ranks(fabric, controller.config, agg)
+        controller.bus.publish(ev.EventStatsFlush())  # baseline snap
+        reports = LoadGen(controller, fabric).run([
+            TenantSpec("victim", rate=50.0, n_requests=60, macs=vic),
+            TenantSpec("aggressor", rate=6000.0, n_requests=1800,
+                       kind="alltoall", macs=agg, ranks=tuple(ranks)),
+        ])
+        controller.bus.publish(ev.EventStatsFlush())  # trigger pass
+        return fabric, controller, reports
+    finally:
+        if trace_sink is not None:
+            remove_trace_sink(trace_sink)
+
+
+class TestEndToEndSLOSoak:
+    def test_storm_fires_burn_trigger_and_names_tenant_and_stage(self):
+        """Acceptance: the unprotected aggressor storm burns the
+        victim's latency SLO; the frozen bundle names the burning
+        tenant AND the dominant stage from the span trees. From the
+        SAME run, the Perfetto export carries span slices and >= 3
+        counter tracks."""
+        from sdnmpi_tpu.api.traceview import TraceCollector
+
+        collector = TraceCollector()
+        fabric, controller, reports = _run_storm(
+            admission_rate=0.0, trace_sink=collector
+        )
+        assert reports["victim"].completed > 0
+        slo_bundles = [
+            b for b in controller.flight.bundles
+            if b["trigger"].startswith("slo:")
+        ]
+        assert slo_bundles, (
+            "no SLO burn bundle frozen; victim p99 was "
+            f"{reports['victim'].p99_ms:.1f} ms vs target "
+            f"{VICTIM_TARGET_MS} ms"
+        )
+        bundle = slo_bundles[-1]
+        assert bundle["detail"]["tenant"] == "victim"
+        assert bundle["detail"]["burn_fast"] >= 8.0
+        # the slo context names the dominant stage from the span trees
+        assert bundle["slo"]["dominant_stage"] is not None
+        assert bundle["slo"]["stage_self_ms"]
+        assert bundle["slo"]["targets"]["victim"]["p99_ms"] == (
+            VICTIM_TARGET_MS
+        )
+        # the bundle's own trees contain real pipeline stages
+        names = {
+            node["name"]
+            for tree in bundle["span_trees"]
+            for node in tree["nodes"].values()
+        }
+        assert "route_window" in names
+
+        # Perfetto export from the same run: slices AND counter tracks
+        trace = _export(controller, collector)
+        slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        counter_names = {
+            e["name"] for e in trace["traceEvents"] if e.get("ph") == "C"
+        }
+        assert slices, "no span slices in the export"
+        assert len(counter_names) >= 3, counter_names
+
+    def test_admission_protects_the_slo(self):
+        """Acceptance: the same storm with admission on — the victim's
+        latency stays inside the objective and NO SLO trigger fires."""
+        fabric, controller, reports = _run_storm(admission_rate=100.0)
+        assert not [
+            b for b in controller.flight.bundles
+            if b["trigger"].startswith("slo:")
+        ], [b["trigger"] for b in controller.flight.bundles]
+        assert REGISTRY.get(
+            "slo_burn_triggers_total"
+        ).values.get("victim", 0) == 0
+
+
+def _export(controller, collector):
+    import pathlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        path = str(pathlib.Path(td) / "trace.json")
+        collector.dump(path, timeline=controller.timeline)
+        return json.loads(pathlib.Path(path).read_text())

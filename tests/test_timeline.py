@@ -1,0 +1,243 @@
+"""Metrics timeline tests (ISSUE 14, utils/timeline.py): compact rows,
+multi-resolution downsampling, derived series, the ``timeline()`` pull
+RPC, and the Perfetto counter-track export."""
+
+from __future__ import annotations
+
+import pytest
+
+from sdnmpi_tpu.utils.metrics import REGISTRY
+from sdnmpi_tpu.utils.timeline import (
+    DEFAULT_TRACKS,
+    MetricsTimeline,
+    estimate_p99,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    REGISTRY.reset()
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    return {
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": histograms or {},
+    }
+
+
+class TestCompactRows:
+    def test_scalars_and_histogram_figures(self):
+        tl = MetricsTimeline(clock=lambda: 10.0)
+        row = tl.tick(_snap(
+            counters={"a_total": 3},
+            gauges={"g": 1.5},
+            histograms={"h_seconds": {
+                "buckets": [0.1, 1.0], "counts": [2, 1, 0],
+                "sum": 0.4, "count": 3,
+            }},
+        ))
+        assert row["a_total"] == 3
+        assert row["g"] == 1.5
+        assert row["h_seconds_count"] == 3
+        assert row["ts"] == 10.0 and "t_pc" in row
+
+    def test_interval_p99_is_delta_based(self):
+        tl = MetricsTimeline(clock=lambda: 0.0)
+        h1 = {"buckets": list((0.0001, 0.001, 0.01, 0.1, 1.0)),
+              "counts": [100, 0, 0, 0, 0, 0], "sum": 0.0, "count": 100}
+        tl.tick(_snap(histograms={"install_e2e_seconds": h1}))
+        # next interval: 10 NEW slow observations land in the 1.0 bucket
+        h2 = {"buckets": h1["buckets"],
+              "counts": [100, 0, 0, 0, 10, 0], "sum": 5.0, "count": 110}
+        row = tl.tick(_snap(histograms={"install_e2e_seconds": h2}))
+        # lifetime p99 would be 0.0001s; the INTERVAL p99 is 1s
+        assert row["install_e2e_seconds_p99_ms"] == 1000.0
+
+    def test_cache_hit_rate_is_interval_based(self):
+        tl = MetricsTimeline(clock=lambda: 0.0)
+        tl.tick(_snap(counters={"route_cache_hits_total": 90,
+                                "route_cache_misses_total": 10}))
+        row = tl.tick(_snap(counters={"route_cache_hits_total": 90,
+                                      "route_cache_misses_total": 20}))
+        assert row["route_cache_hit_rate"] == 0.0  # interval: 0/10
+
+
+class TestDownsampling:
+    def test_memory_bounded_and_history_extended(self):
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        tl = MetricsTimeline(maxlen=16, decimation=4, levels=3,
+                             clock=clock)
+        for i in range(400):
+            tl.tick(_snap(gauges={"g": float(i)}))
+        assert len(tl.levels[0]) == 16
+        assert len(tl.levels[1]) == 16
+        # level 2 covers 16 * 16 = 256 flushes back
+        rows = tl.rows()
+        assert len(rows) <= 48
+        span = rows[-1]["ts"] - rows[0]["ts"]
+        assert span > 16 * 4, span  # far beyond level 0's reach
+        # merged history is strictly ordered with no duplicate ts
+        ts = [r["ts"] for r in rows]
+        assert ts == sorted(ts) and len(set(ts)) == len(ts)
+
+    def test_series_filters(self):
+        tl = MetricsTimeline(clock=lambda: 1.0)
+        tl.tick(_snap(gauges={"a": 1.0, "b": 2.0}))
+        out = tl.series(["a"])
+        assert set(out["series"]) == {"a"}
+        assert out["n_rows"] == 1
+
+
+class TestCounterTracks:
+    def test_tracks_on_perf_counter_clock(self):
+        tl = MetricsTimeline(clock=lambda: 5.0)
+        tl.tick(_snap(gauges={"congestion_hot_link_bps": 7.0}))
+        tracks = tl.counter_tracks()
+        names = {t["name"] for t in tracks}
+        assert "congestion_hot_link_bps" in names
+        track = next(t for t in tracks
+                     if t["name"] == "congestion_hot_link_bps")
+        assert track["points"][0][1] == 7.0
+
+    def test_traceview_renders_counter_events(self):
+        from sdnmpi_tpu.api.traceview import chrome_trace
+
+        records = [{
+            "kind": "span", "name": "packet_in", "span": 1, "parent": 0,
+            "t0": 100.0, "t1": 100.5, "wall_ms": 500.0,
+        }]
+        counters = [{"name": "route_cache_hit_rate",
+                     "points": [[100.1, 0.5], [100.2, 0.9]]}]
+        trace = chrome_trace(records, counters=counters)
+        cs = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert len(cs) == 2 and len(xs) == 1
+        # counter ts rides the same rebased clock as the slices
+        assert cs[0]["ts"] == pytest.approx(0.1 * 1e6, rel=1e-3)
+
+    def test_counters_alone_still_render(self):
+        from sdnmpi_tpu.api.traceview import chrome_trace
+
+        trace = chrome_trace([], counters=[
+            {"name": "g", "points": [[1.0, 2.0]]}
+        ])
+        assert [e["ph"] for e in trace["traceEvents"]] == ["C"]
+
+    def test_empty_pointed_counters_yield_empty_trace(self):
+        """Review pin: counters= with only empty-pointed tracks (and no
+        spans) is an empty trace, not a ValueError from min()."""
+        from sdnmpi_tpu.api.traceview import chrome_trace
+
+        trace = chrome_trace([], counters=[{"name": "x", "points": []}])
+        assert trace["traceEvents"] == []
+
+
+class TestControllerIntegration:
+    def _stack(self, **cfg):
+        from sdnmpi_tpu.config import Config
+        from sdnmpi_tpu.control.controller import Controller
+        from sdnmpi_tpu.topogen import linear
+
+        spec = linear(4)
+        fabric = spec.to_fabric()
+        controller = Controller(fabric, Config(
+            enable_monitor=False, **cfg,
+        ))
+        controller.attach()
+        return fabric, controller
+
+    def test_flush_records_one_row_via_flight_tee(self):
+        from sdnmpi_tpu.control import events as ev
+
+        _, controller = self._stack()
+        assert controller.flight.on_snapshot is not None
+        controller.bus.publish(ev.EventStatsFlush())
+        controller.bus.publish(ev.EventStatsFlush())
+        assert controller.timeline.n_recorded == 2
+
+    def test_flush_records_without_flight(self):
+        from sdnmpi_tpu.control import events as ev
+
+        _, controller = self._stack(flight_recorder=False)
+        assert controller.flight is None
+        controller.bus.publish(ev.EventStatsFlush())
+        assert controller.timeline.n_recorded == 1
+
+    def test_timeline_off_knob(self):
+        _, controller = self._stack(metrics_timeline=False)
+        assert controller.timeline is None
+
+    def test_timeline_pull_request(self):
+        from sdnmpi_tpu.control import events as ev
+
+        _, controller = self._stack()
+        controller.bus.publish(ev.EventStatsFlush())
+        reply = controller.bus.request(ev.TimelineRequest())
+        assert reply.timeline["n_rows"] == 1
+        assert reply.timeline["series"]
+        filtered = controller.bus.request(ev.TimelineRequest(
+            names=["device_memory_in_use_bytes"]
+        )).timeline
+        assert set(filtered["series"]) <= {"device_memory_in_use_bytes"}
+
+    def test_timeline_rpc_method(self):
+        from sdnmpi_tpu.api.rpc import RPCInterface
+        from sdnmpi_tpu.control import events as ev
+
+        _, controller = self._stack()
+        controller.bus.publish(ev.EventStatsFlush())
+        rpc = RPCInterface(controller.bus, controller.config)
+        reply = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 7, "method": "timeline",
+            "params": [],
+        })
+        assert reply["id"] == 7 and reply["result"]["n_rows"] == 1
+        # review pin: a bare-string param is ONE series name, never an
+        # iterable of characters (which would filter everything out)
+        reply = rpc.handle_request({
+            "jsonrpc": "2.0", "id": 8, "method": "timeline",
+            "params": ["device_memory_in_use_bytes"],
+        })
+        assert set(reply["result"]["series"]) == {
+            "device_memory_in_use_bytes"
+        }
+
+    def test_default_tracks_present_after_serving_traffic(self):
+        """The acceptance's counter-track set: after real traffic +
+        flushes, the curated Perfetto tracks exist with data."""
+        from sdnmpi_tpu.control import events as ev
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric, controller = self._stack(
+            coalesce_routes=True, coalesce_window_s=10.0,
+        )
+        macs = sorted(fabric.hosts)
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=macs[1], payload=b"x"),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        controller.bus.publish(ev.EventStatsFlush())
+        names = {t["name"] for t in controller.timeline.counter_tracks()}
+        assert {"install_e2e_seconds_p99_ms",
+                "device_memory_in_use_bytes"} <= names
+        assert names <= set(DEFAULT_TRACKS)
+
+
+class TestEstimator:
+    def test_shared_estimator_matches_flight(self):
+        from sdnmpi_tpu.utils.flight import _estimate_p99
+
+        assert _estimate_p99 is estimate_p99
+        assert estimate_p99([0.1, 1.0], [0, 5, 0]) == 1.0
+        assert estimate_p99([0.1, 1.0], [0, 0, 0]) == 0.0
