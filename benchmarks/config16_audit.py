@@ -1,0 +1,210 @@
+"""Config 16: fabric audit plane — sweep wall + divergence repair cost.
+
+The audit plane (control/audit.py, ISSUE 15) gives the controller a
+ground-truth channel: per flush a shard of the switch space answers
+OFPST_FLOW and the replies diff against the desired store. This config
+prices that channel at fat-tree k=16 (320 switches) with a routed flow
+population, on the wire-mode sim (the stats bytes are real multipart
+OF 1.0):
+
+- ``audit_sweep_ms`` (headline): wall of ONE full-fabric audit sweep —
+  flow-stats pull (encode + multipart decode), canonicalize, diff
+  against the desired store, attribution — median over several sweeps.
+  vs_baseline is the honest alternative's cost for the SAME assurance
+  (installed == desired, fabric-wide, against silent corruption): a
+  controller without ground truth cannot know WHICH switch is corrupt,
+  so its only lever is the PR-5 escalation applied everywhere — wipe
+  every table and re-drive every desired set. That full-fabric
+  wipe-resync wall divided by (one audit sweep + the targeted repair
+  of the actual corruption). >1 means verified parity via audit beats
+  parity via blanket resync.
+- ``divergence_detect_ms`` (extra row): MARGINAL wall from an injected
+  silent table mutation to confirmed detection + targeted heal under
+  the paced deployment posture (the steady sweep already runs; the
+  increment is the victim's confirm audits + a one-row re-drive).
+  Detection latency in sweep PERIODS is bounded by
+  ``audit_confirm_sweeps`` by construction; the fence in
+  tests/test_audit.py pins that bound.
+
+Runs entirely host-side (py oracle, wire-mode sim fabric) — safe
+without the TPU lock, like config 11.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+FATTREE_K = 16  # 320 switches, 1024 hosts
+N_PAIRS = 1536
+N_SWEEPS = 5
+N_MUTATIONS = 8
+
+
+def build(k: int = FATTREE_K, n_pairs: int = N_PAIRS):
+    """A wire-mode fat-tree with a routed flow population and the audit
+    plane armed full-fabric (no pacing — the sweep IS the measurement).
+    Test-scale callers shrink ``k``/``n_pairs``."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    fabric = spec.to_fabric(wire=True)
+    config = Config(
+        oracle_backend="py",
+        enable_monitor=False,
+        coalesce_routes=True,
+        audit_switches_per_flush=0,  # whole fabric per sweep
+        audit_confirm_sweeps=2,
+        install_retry_backoff_s=0.0,
+        barrier_timeout_s=0.0,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    assert controller.audit is not None
+
+    rng = np.random.default_rng(0)
+    hosts = sorted(fabric.hosts)
+    pairs = set()
+    while len(pairs) < n_pairs:
+        a, b = rng.choice(len(hosts), size=2, replace=False)
+        pairs.add((hosts[a], hosts[b]))
+    pairs = sorted(pairs)
+    controller.router.reinstall_pairs(pairs)
+    return spec, fabric, controller, pairs
+
+
+def pump(fabric, pairs) -> None:
+    """One data-plane packet per pair — counters tick along every
+    installed path (the attribution/counter-dead input)."""
+    from sdnmpi_tpu.protocol import openflow as of
+
+    for src, dst in pairs:
+        fabric.hosts[src].send(of.Packet(src, dst, of.ETH_TYPE_IP))
+
+
+def sweep_walls_ms(controller, fabric, pairs, n_sweeps: int = N_SWEEPS):
+    """Wall of ``n_sweeps`` full-fabric audit sweeps (clean fabric)."""
+    walls = []
+    for _ in range(n_sweeps):
+        pump(fabric, pairs)
+        t0 = time.perf_counter()
+        confirmed = controller.audit.sweep()
+        walls.append((time.perf_counter() - t0) * 1e3)
+        assert confirmed == [], "clean fabric must not diverge"
+    return walls
+
+
+def detect_and_heal_ms(controller, fabric, pairs, plan,
+                       n_mutations: int = N_MUTATIONS):
+    """Marginal wall of repairing one corruption under the PACED
+    deployment posture: the steady-state sweep is already running (its
+    period cost is the headline row), so the increment a corruption
+    adds is the victim's confirm audits plus the one-row re-drive —
+    measured by pinning the sweep shard to the victim
+    (``request_verify``, the wipe-and-resync verify seam) with pacing
+    at one switch per flush. Mutation kinds are the TABLE-VISIBLE ones
+    (drop/insert/blackhole): counter-dead detection is clocked by full
+    sweep cycles — cross-switch evidence the victim-pinned regime never
+    gathers — so its latency is a sweep-period figure (the soak fence
+    in tests/test_audit.py), not a marginal-wall one."""
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    fam = REGISTRY.get("fabric_divergence_total")
+    per_flush = controller.config.audit_switches_per_flush
+    controller.config.audit_switches_per_flush = 1
+    kinds = ("drop_row", "insert_row", "blackhole")
+    walls = []
+    try:
+        for i in range(n_mutations):
+            rec = plan.mutate(kind=kinds[i % len(kinds)])
+            assert rec is not None, "no eligible row to mutate"
+            victim = rec[0]
+            before = sum(fam.values.values())
+            wall = 0.0
+            for _sweep in range(8):
+                pump(fabric, pairs)  # traffic is the fabric's bill
+                controller.audit.request_verify(victim)
+                t0 = time.perf_counter()
+                controller.audit.sweep()
+                wall += time.perf_counter() - t0
+                if sum(fam.values.values()) > before:
+                    break
+            walls.append(wall * 1e3)
+            assert sum(fam.values.values()) == before + 1, (
+                "mutation not detected exactly once"
+            )
+    finally:
+        controller.config.audit_switches_per_flush = per_flush
+    return walls
+
+
+def wipe_resync_ms(controller, fabric) -> float:
+    """The pre-audit alternative priced: guarantee installed == desired
+    fabric-wide WITHOUT ground truth. A controller that cannot see the
+    tables cannot know which switch is corrupt, so its only lever is
+    the PR-5 escalation applied to every switch — wipe every table and
+    re-drive every desired set (the mass-redial storm the rate-shaped
+    reconcile satellite exists for)."""
+    router = controller.router
+    t0 = time.perf_counter()
+    for dpid in sorted(fabric.switches):
+        router._resync_datapath(dpid)
+    return (time.perf_counter() - t0) * 1e3
+
+
+def targeted_repair_ms(controller, fabric, pairs, plan) -> float:
+    """The audit's answer to the same corruption: detect + re-drive
+    exactly the diverged row (median of the detect-and-heal walls)."""
+    return float(np.median(
+        detect_and_heal_ms(controller, fabric, pairs, plan)
+    ))
+
+
+def main() -> None:
+    from sdnmpi_tpu.control.faults import FaultPlan
+
+    t0 = time.perf_counter()
+    spec, fabric, controller, pairs = build()
+    n_flows = controller.router.recovery.desired.total()
+    log(
+        f"built fat-tree k={FATTREE_K}: {len(fabric.switches)} switches, "
+        f"{n_flows} desired flows for {N_PAIRS} pairs "
+        f"({time.perf_counter() - t0:.1f}s)"
+    )
+
+    walls = sweep_walls_ms(controller, fabric, pairs)
+    headline = float(np.median(walls))
+    log(f"full-fabric sweep: {headline:.2f} ms median over {len(walls)}")
+
+    plan = FaultPlan(
+        seed=16, mutate_priority=controller.config.priority_default
+    ).attach(fabric)
+    repair = targeted_repair_ms(controller, fabric, pairs, plan)
+    wipe = wipe_resync_ms(controller, fabric)
+    audited = headline + repair  # verified parity via the audit plane
+    log(f"verified parity: audit sweep + targeted repair "
+        f"{audited:.2f} ms vs full-fabric wipe-resync {wipe:.2f} ms")
+
+    emit(
+        "audit_sweep_ms", headline, "ms",
+        vs_baseline=wipe / audited if audited else 0.0,
+        wipe_resync_all_ms=round(wipe, 3),
+        targeted_repair_ms=round(repair, 3),
+        n_switches=len(fabric.switches),
+        n_desired_flows=n_flows,
+        sweep_walls_ms=[round(w, 3) for w in walls],
+    )
+    emit(
+        "divergence_detect_ms", repair, "ms",
+        vs_baseline=1.0,  # no reference figure: the reference never detects
+        n_mutations=len(plan.mutations),
+    )
+
+
+if __name__ == "__main__":
+    main()

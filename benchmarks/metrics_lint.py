@@ -9,12 +9,16 @@ Holds the telemetry plane honest from both sides, without a TPU:
    renamed metric with stale docs, or a prefix no owner claims
    (``owner == "?"``) all fail.
 2. **Liveness under soak** — a short simulated serving soak (coalesced
-   windows, a proactive collective, Monitor stats, a link flap, an
-   admission storm, SLO targets, flight/timeline ticks) must MOVE
-   every metric outside the exempt set. A metric that stays zero
-   through all of that is either dead (registered but never touched —
-   the lint's reason to exist) or belongs in ``SOAK_EXEMPT`` with a
-   category comment.
+   windows, a proactive collective, Monitor stats + fabric audit
+   sweeps, a link flap, an admission storm, SLO targets,
+   flight/timeline ticks) must MOVE every metric outside the exempt
+   set. A metric that stays zero through all of that is either dead
+   (registered but never touched — the lint's reason to exist) or
+   belongs in ``SOAK_EXEMPT`` with a category comment.
+3. **Timeline-channel completeness** (ISSUE 15) — every LABELED metric
+   family must declare how it flattens into a timeline channel
+   (utils/timeline.LABELED_CHANNELS); plain instruments map
+   automatically.
 
 Wired beside the other no-TPU CI gates: ``python -m benchmarks.run
 --metrics-lint`` and tests/test_metrics_lint.py run the same
@@ -45,7 +49,11 @@ SOAK_EXEMPT = {
     "oracle_repairs_total",  # repair needs delta-log-coverable churn
     "reconcile_flows_total",  # a crash/redial cycle, not a flap
     "reconcile_passes_total",
+    "reconcile_deferred_total",  # needs a shaped mass-redial storm
     "recovery_redrive_seconds",
+    "audit_switches_skipped_total",  # needs in-flight recovery / lost stats
+    "audit_heals_total",  # a healthy fabric has nothing to heal
+    "fabric_diverged_switches",  # 0 IS the healthy reading
     "slo_burn_triggers_total",  # an SLO burn is an incident
     "flight_dumps_total",  # needs a dump dir
     "profile_captures_total",  # needs --profile-dump + an anomaly
@@ -275,6 +283,22 @@ def run_metrics_lint(readme_path: str = "README.md",
                 f"{r['name']}: no owner prefix in "
                 "api/telemetry.METRIC_OWNERS"
             )
+    # timeline-channel completeness (ISSUE 15 satellite): plain
+    # counters/gauges/histograms flow into timeline rows automatically,
+    # but a LABELED family is only visible on the timeline through its
+    # declared flattening — an instrument registered without a channel
+    # mapping is history you cannot query when its regression pages
+    from sdnmpi_tpu.utils.metrics import LabeledCounter, LabeledHistogram
+    from sdnmpi_tpu.utils.timeline import LABELED_CHANNELS
+
+    for name, inst in REGISTRY:
+        if isinstance(inst, (LabeledCounter, LabeledHistogram)):
+            if name not in LABELED_CHANNELS:
+                errors.append(
+                    f"{name}: labeled family registered without a "
+                    "timeline channel mapping "
+                    "(utils/timeline.LABELED_CHANNELS)"
+                )
     for name in sorted(registered - documented):
         errors.append(
             f"{name}: registered but undocumented in the README "

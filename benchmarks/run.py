@@ -40,6 +40,7 @@ CONFIGS = [
     ("13", [sys.executable, "-m", "benchmarks.config13_shard"]),
     ("14", [sys.executable, "-m", "benchmarks.config14_serving"]),
     ("15", [sys.executable, "-m", "benchmarks.config15_hier"]),
+    ("16", [sys.executable, "-m", "benchmarks.config16_audit"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

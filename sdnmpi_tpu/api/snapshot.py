@@ -33,9 +33,29 @@ def snapshot_controller(controller) -> dict:
         db.route_cache.snapshot_entries(db)
         if db.route_cache is not None else None
     )
+    # the desired-flow store rides the checkpoint beside the route-cache
+    # memo (ISSUE 15 satellite, carried from PR 5): a restarted
+    # controller re-seeds what SHOULD be installed and then AUDITS the
+    # fabric it left behind (the switches kept their tables across the
+    # controller restart) instead of starting blind — the audit plane's
+    # first sweeps reconcile any drift accumulated while it was down.
+    # Topology-digest guarded like the route cache: a controller that
+    # discovered a different fabric restores nothing.
+    from sdnmpi_tpu.oracle.routecache import RouteCache
+
+    desired = controller.router.recovery.desired
     return {
         "version": SNAPSHOT_VERSION,
         "route_cache": route_cache,
+        "desired_flows": {
+            "topology_digest": RouteCache.topology_digest(db),
+            "rows": [
+                [dpid, src, dst, spec.out_port, spec.rewrite,
+                 spec.collective]
+                for dpid, table in sorted(desired.flows.items())
+                for (src, dst), spec in sorted(table.items())
+            ],
+        },
         "topology": controller.topology_manager.topologydb.to_dict(),
         "fdb": controller.router.fdb.to_dict(),
         "rankdb": controller.process_manager.rankdb.to_dict(),
@@ -95,6 +115,27 @@ def restore_controller(controller, snapshot: dict) -> None:
     controller.topology_manager.restore_link_util(
         {(dpid, port): bps for dpid, port, bps in snapshot.get("link_util", [])}
     )
+
+    # Re-seed the desired-flow store (ISSUE 15 satellite) so the
+    # restarted controller knows what SHOULD be installed before any
+    # reinstall below runs — and so the audit plane's first sweeps
+    # verify the fabric it left behind instead of reading a warm
+    # switch's surviving rows as orphans. Digest-guarded: a different
+    # fabric restores nothing (the reinstall passes rebuild the store
+    # from live routing anyway).
+    des = snapshot.get("desired_flows")
+    if des and des.get("rows"):
+        from sdnmpi_tpu.oracle.routecache import RouteCache
+
+        if des.get("topology_digest") == RouteCache.topology_digest(db):
+            desired = controller.router.recovery.desired
+            for dpid, src, dst, out_port, rewrite, collective in des[
+                "rows"
+            ]:
+                desired.record(
+                    int(dpid), src, dst, int(out_port), rewrite,
+                    bool(collective),
+                )
 
     # Re-seed the route-cache memo BEFORE any re-routing below: the
     # reinstall passes then hit the restored entries (hit == miss

@@ -159,6 +159,7 @@ INSTRUMENTED_MODULES = (
     "sdnmpi_tpu.control.router",
     "sdnmpi_tpu.control.southbound",
     "sdnmpi_tpu.control.admission",
+    "sdnmpi_tpu.control.audit",
     "sdnmpi_tpu.control.slo",
     "sdnmpi_tpu.control.recovery",
     "sdnmpi_tpu.control.monitor",
@@ -179,6 +180,7 @@ INSTRUMENTED_MODULES = (
 #: their names here)
 METRIC_OWNERS = (
     ("admission_", "control/admission"),
+    ("audit_", "control/audit"),
     ("barrier_", "control/recovery"),
     ("barriers_pending", "control/recovery"),
     ("desired_flows", "control/recovery"),
@@ -189,6 +191,9 @@ METRIC_OWNERS = (
     ("echo_", "control/southbound"),
     ("event_log_", "utils/event_log"),
     ("fabric_", "control/fabric"),
+    ("fabric_divergence_", "control/audit"),
+    ("fabric_diverged_", "control/audit"),
+    ("fabric_tenant_", "control/audit"),
     ("flight_", "utils/flight"),
     ("hier_", "oracle/hier"),
     ("install_e2e_", "control/router"),

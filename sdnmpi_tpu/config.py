@@ -338,6 +338,31 @@ class Config:
     #: semantics, bit-identical to the host dict rebuild).
     util_stale_horizon_s: float = 0.0
 
+    # --- fabric audit plane (control/audit.py; ISSUE 15) ------------------
+    #: continuous ground-truth audit of the fabric: per EventStatsFlush
+    #: a shard of the switch space answers OFPST_FLOW, the replies
+    #: canonicalize, and the audit diffs them against the
+    #: DesiredFlowStore three ways (missing desired rows, orphan rows
+    #: the store never recorded, counter-dead rows that should carry
+    #: traffic), healing confirmed divergence through the PR-5
+    #: reconcile path as TARGETED re-drives (one row, not a wipe).
+    #: Only arms when the southbound can answer flow stats. False
+    #: restores the trust-the-install posture byte-identically.
+    fabric_audit: bool = True
+    #: switches audited per EventStatsFlush (the sweep's pacing cursor,
+    #: the install_highwater round-robin idiom at the stats plane: a
+    #: 1024-switch fabric audits in bounded per-flush slices instead of
+    #: one burst). 0 = the whole fabric every flush.
+    audit_switches_per_flush: int = 64
+    #: consecutive sweeps a suspected divergence must survive before it
+    #: is CONFIRMED (counted + healed + bundle-frozen). 2 (default)
+    #: absorbs one-sweep transients — a packet-out-bypassed first
+    #: packet, an install racing the sweep; 1 confirms table-visible
+    #: kinds (missing/orphan) immediately. Counter-dead always needs
+    #: >= 2 sightings: one flat-while-pair-advanced interval is what
+    #: ordinary traffic cessation looks like.
+    audit_confirm_sweeps: int = 2
+
     # --- recovery plane (control/recovery.py; ISSUE 5) --------------------
     #: master switch for the failure-domain recovery plane: desired-flow
     #: reconciliation on EventDatapathUp, the bounded install retry
@@ -356,6 +381,14 @@ class Config:
     #: seconds an install window may await its barrier ack before the
     #: anti-entropy pass treats it as lost and resyncs the switch
     barrier_timeout_s: float = 2.0
+    #: cap on datapath-up reconciles served per Monitor flush window
+    #: (ISSUE 15 satellite, carried from PR 5): a power-cycled pod
+    #: redialing all at once otherwise re-drives every switch's desired
+    #: set in one synchronous burst and floods the install plane.
+    #: Reconciles past the cap defer to following flush ticks
+    #: (reconcile_deferred_total counts them, FIFO order preserved).
+    #: 0 = unshaped (reconcile immediately on EventDatapathUp).
+    reconcile_max_per_flush: int = 0
     #: bounded retries per switch for dropped/un-acked install windows;
     #: exhaustion escalates to a full datapath resync (table wipe +
     #: EventDatapathUp re-drive) instead of silent divergence

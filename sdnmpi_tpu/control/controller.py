@@ -124,6 +124,27 @@ class Controller:
             )
             self.router.slo = self.slo
 
+        # fabric ground-truth audit plane (ISSUE 15, control/audit.py):
+        # per-flush OFPST_FLOW sweeps diff the fabric's actual tables
+        # against the desired store and heal confirmed divergence as
+        # targeted re-drives. Arms only when the southbound can answer
+        # flow stats (the sim Fabric and OFSouthbound both can; duck-
+        # typed minimal test stacks cannot). Subscribed BEFORE the
+        # flight recorder so the trigger pass sees the same flush's
+        # fresh divergence counters.
+        self.audit = None
+        if config.fabric_audit and hasattr(southbound, "flow_stats"):
+            from sdnmpi_tpu.control.audit import AuditPlane
+
+            self.audit = AuditPlane(config, southbound, self.router)
+            self.router.audit = self.audit
+            # the congestion report's measured-vs-modeled column reads
+            # the audit's attribution (TopologyManager._assemble_congestion)
+            self.topology_manager.audit = self.audit
+            self.bus.subscribe(
+                ev.EventStatsFlush, lambda e: self.audit.sweep()
+            )
+
         # anomaly-armed profiler capture (ISSUE 14): a firing trigger
         # opens a jax.profiler window for profile_capture_s seconds
         self.profile_capture = None
@@ -174,6 +195,11 @@ class Controller:
                 flight.add_context(
                     "slo", lambda: self.slo.forensics(self.flight)
                 )
+            if self.audit is not None:
+                # fabric divergence is ALWAYS an incident: the frozen
+                # bundle's detail names the switch and rows (ISSUE 15)
+                flight.triggers.append(self.audit.trigger())
+                flight.add_context("audit", self.audit.forensics)
             flight.on_anomaly = self._publish_anomaly
             flight.arm()
             self.bus.tap(flight.event_tap)

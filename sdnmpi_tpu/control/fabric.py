@@ -72,9 +72,15 @@ class _FlowEntry:
     last_hit: float = 0.0
     packet_count: int = 0
     byte_count: int = 0
+    cookie: int = 0
     #: True for the per-lookup entries synthesized from the block table
     #: (they carry no expiry state and are not in flow_table)
     synthetic: bool = False
+    #: fault-injection state (control/faults.py "freeze" mutation): the
+    #: entry still matches and forwards but its counters stopped — the
+    #: dead-counter-ASIC fault the audit plane's counter-dead diff
+    #: exists to catch
+    frozen: bool = False
 
 
 class _BlockSetEntry:
@@ -193,6 +199,7 @@ class SimSwitch:
                 idle_timeout=mod.idle_timeout,
                 hard_timeout=mod.hard_timeout,
                 installed_at=now, last_hit=now,
+                cookie=mod.cookie,
             )
             bucket.append(entry)
             self.flow_table.append(entry)
@@ -275,9 +282,10 @@ class SimSwitch:
         port.rx_bytes += _pkt_len(pkt)
 
         entry = self.lookup(pkt, in_port)
-        if entry is not None and not entry.synthetic:
+        if entry is not None and not entry.synthetic and not entry.frozen:
             # scalar-table hit: refresh the idle clock + counters (block
-            # entries are synthesized per lookup and don't expire)
+            # entries are synthesized per lookup and don't expire; a
+            # fault-frozen entry forwards without counting)
             entry.last_hit = self.fabric.now
             entry.packet_count += 1
             entry.byte_count += _pkt_len(pkt)
@@ -332,6 +340,25 @@ class SimSwitch:
                 p.port_no, p.rx_packets, p.rx_bytes, p.tx_packets, p.tx_bytes
             )
             for p in sorted(self.ports.values(), key=lambda p: p.port_no)
+        ]
+
+    def flow_stats(self) -> list[of.FlowStatsEntry]:
+        """The scalar flow table as OFPST_FLOW records — the audit
+        plane's ground truth (ISSUE 15). Counters are the data-plane
+        tallies the sim already keeps; block-table entries are NOT
+        reported (they are this framework's array extension with no
+        table rows a real OFPST_FLOW dump would carry — the collective
+        table owns their lifecycle)."""
+        now = self.fabric.now
+        return [
+            of.FlowStatsEntry(
+                match=e.match, actions=e.actions, priority=e.priority,
+                duration_sec=int(now - e.installed_at),
+                idle_timeout=e.idle_timeout, hard_timeout=e.hard_timeout,
+                cookie=e.cookie, packet_count=e.packet_count,
+                byte_count=e.byte_count,
+            )
+            for e in self.flow_table
         ]
 
     def to_entity(self) -> Switch:
@@ -892,6 +919,31 @@ class Fabric:
 
             entries = ofwire.decode_port_stats_reply(
                 ofwire.encode_port_stats_reply(entries, xid=self._next_xid())
+            )
+        return entries
+
+    def flow_stats(self, dpid: int):
+        """Pull one switch's flow table (OFPST_FLOW, ISSUE 15). Returns
+        None — NOT an empty list — when no reply is available (unknown
+        datapath, or the fault plan delayed the StatsReply): the audit
+        plane must never read "no answer" as "empty table", or a
+        delayed reply would condemn every desired row as missing. With
+        ``wire=True`` the reply round-trips the MULTIPART byte codec
+        (encode splits on record boundaries, decode reassembles), so
+        the sim proves the same part stream a real switch would send."""
+        sw = self.switches.get(dpid)
+        if sw is None:
+            return None
+        if self.faults is not None and self.faults.stats_fault(dpid):
+            return None  # delayed StatsReply: nothing to serve this pull
+        entries = sw.flow_stats()
+        if self.wire:
+            from sdnmpi_tpu.protocol import ofwire
+
+            entries = ofwire.decode_flow_stats_reply(
+                ofwire.encode_flow_stats_reply(
+                    entries, xid=self._next_xid()
+                )
             )
         return entries
 

@@ -39,6 +39,16 @@ DROP = "drop"  #: the bytes never reach the switch (verdict: dropped)
 STALL = "stall"  #: queued behind a frozen stream; applied on release
 TRUNCATE = "truncate"  #: a frame boundary is cut mid-span; tail is lost
 
+#: table-mutation kinds (ISSUE 15): silent flow-table corruption behind
+#: the controller's back — no event fires, no verdict reports it; ONLY
+#: the audit plane's ground-truth sweep (control/audit.py) can see it
+MUTATE_KINDS = (
+    "drop_row",  #: a desired row vanishes (missing)
+    "insert_row",  #: a bogus row appears (orphan)
+    "blackhole",  #: a row's actions become drop (missing via mismatch)
+    "freeze",  #: a row forwards but its counters die (counter-dead)
+)
+
 
 class FaultPlan:
     """Seeded fault schedule (see module docstring).
@@ -63,6 +73,9 @@ class FaultPlan:
         p_restore: float = 0.5,
         p_release: float = 0.5,
         max_crashed: int = 2,
+        p_mutate: float = 0.0,
+        mutate_kinds=MUTATE_KINDS,
+        mutate_priority: int = 0x8000,
     ) -> None:
         self.rng = random.Random(seed)
         self.p_send_drop = p_send_drop
@@ -76,15 +89,24 @@ class FaultPlan:
         self.p_restore = p_restore
         self.p_release = p_release
         self.max_crashed = max_crashed
+        self.p_mutate = p_mutate
+        self.mutate_kinds = tuple(mutate_kinds)
+        #: priority of the rows mutations target (the Router's install
+        #: priority — Config.priority_default; the audit plane's scope)
+        self.mutate_priority = mutate_priority
         self.fabric = None
         self.active = True
         #: links taken down by step() (not by crashes), awaiting restore
         self.flapped: list[tuple[int, int, int, int]] = []
+        #: every injected table mutation: (dpid, kind, (src, dst)) —
+        #: the audit soak's ledger (quiesce() deliberately does NOT
+        #: repair these: only the audit plane's ground-truth sweep can)
+        self.mutations: list[tuple[int, str, tuple[str, str]]] = []
         # injection tallies (the soak prints these beside the registry)
         self.counts: dict[str, int] = {
             DROP: 0, STALL: 0, TRUNCATE: 0, "ack_drop": 0,
             "stats_delay": 0, "crash": 0, "redial": 0, "flap": 0,
-            "restore": 0,
+            "restore": 0, "mutate": 0,
         }
 
     def attach(self, fabric) -> "FaultPlan":
@@ -168,11 +190,101 @@ class FaultPlan:
         for dpid in sorted(fabric._stall_q):
             if rng.random() < self.p_release:
                 fabric.release_stalls(dpid)
+        if self.p_mutate > 0 and rng.random() < self.p_mutate:
+            self.mutate()
+
+    # -- table mutations (ISSUE 15) ---------------------------------------
+
+    def mutate(self, dpid: int | None = None,
+               kind: str | None = None) -> tuple | None:
+        """Inject ONE silent flow-table mutation behind the
+        controller's back (see MUTATE_KINDS) and record it in the
+        ledger. No bus event fires and no verdict reports it — exactly
+        the divergence class only the audit plane's OFPST_FLOW sweep
+        can detect. A row is mutated at most once (re-mutating a row
+        the audit already healed would make the soak's
+        one-divergence-per-mutation accounting ambiguous). Returns the
+        ledger record, or None when no eligible row exists."""
+        from sdnmpi_tpu.protocol import openflow as of
+
+        fabric = self.fabric
+        assert fabric is not None, "attach() a fabric first"
+        rng = self.rng
+        kind = kind or rng.choice(self.mutate_kinds)
+        mutated = {(d, row) for d, _k, row in self.mutations}
+
+        if kind == "insert_row":
+            if not fabric.switches:
+                return None
+            dpid = rng.choice(sorted(fabric.switches)) if dpid is None \
+                else dpid
+            # a bogus exact-match row the controller never desired —
+            # locally-administered MACs from a range no generator host
+            # or vMAC uses, so the row is inert in the data plane
+            while True:
+                src = "0a:fa:00:00:%02x:%02x" % (
+                    rng.randrange(256), rng.randrange(256)
+                )
+                dst = "0a:fb:00:00:%02x:%02x" % (
+                    rng.randrange(256), rng.randrange(256)
+                )
+                if (dpid, (src, dst)) not in mutated:
+                    break
+            fabric.switches[dpid].flow_mod(of.FlowMod(
+                match=of.Match(dl_src=src, dl_dst=dst),
+                actions=(of.ActionOutput(1),),
+                priority=self.mutate_priority,
+            ))
+        else:
+            def eligible(e) -> bool:
+                return (
+                    e.priority == self.mutate_priority
+                    and e.match.dl_src is not None
+                    and e.match.dl_dst is not None
+                    and e.cookie == 0
+                    and not e.frozen and e.actions != ()
+                )
+
+            def rows_of(d):
+                return [
+                    e for e in fabric.switches[d].flow_table
+                    if eligible(e) and (
+                        d, (e.match.dl_src, e.match.dl_dst)
+                    ) not in mutated
+                ]
+
+            if dpid is None:
+                candidates = [
+                    d for d in sorted(fabric.switches) if rows_of(d)
+                ]
+                if not candidates:
+                    return None
+                dpid = rng.choice(candidates)
+            rows = rows_of(dpid)
+            if not rows:
+                return None
+            e = rng.choice(rows)
+            src, dst = e.match.dl_src, e.match.dl_dst
+            if kind == "drop_row":
+                fabric.switches[dpid].drop_entries({id(e)})
+            elif kind == "blackhole":
+                e.actions = ()
+            elif kind == "freeze":
+                e.frozen = True
+            else:
+                raise ValueError(f"unknown mutation kind {kind!r}")
+        rec = (dpid, kind, (src, dst))
+        self.mutations.append(rec)
+        self.counts["mutate"] += 1
+        return rec
 
     def quiesce(self) -> None:
         """Heal the world and stop injecting: every surviving fault is
         repaired so the recovery plane's convergence can be asserted
-        against a quiet fabric."""
+        against a quiet fabric. Table mutations are deliberately NOT
+        repaired — no controller-side machinery ever learns about them
+        except the audit plane's ground-truth sweep, so leaving them in
+        place is exactly what the audit soak asserts against."""
         fabric = self.fabric
         self.active = False
         for dpid in sorted(fabric._crashed):

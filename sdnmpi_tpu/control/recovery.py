@@ -61,6 +61,12 @@ _m_resyncs = REGISTRY.counter(
     "full datapath resyncs (table wipe + state re-drive) after retry "
     "exhaustion",
 )
+_m_reconcile_deferred = REGISTRY.counter(
+    "reconcile_deferred_total",
+    "datapath-up reconciles deferred past the per-flush cap "
+    "(Config.reconcile_max_per_flush — a power-cycled pod redialing at "
+    "once must not flood the install plane)",
+)
 _m_barrier_rtt = REGISTRY.histogram(
     "barrier_rtt_seconds", LATENCY_BUCKETS_S,
     "install window send -> OFPT_BARRIER_REPLY round-trip",
@@ -392,6 +398,19 @@ class RecoveryPlane:
         if stale:
             _m_pending_barriers.set(len(self._pending))
 
+    def in_flight(self, dpid: int) -> bool:
+        """True while this switch has recovery machinery mid-air —
+        un-acked barriers, a queued retry, or parked lost deletes. The
+        audit plane (control/audit.py) skips such switches: their
+        installed-vs-desired gap is already being repaired, and
+        flagging it as fabric divergence would double-drive the repair
+        (and page on what is ordinary retry latency)."""
+        return (
+            dpid in self._retries
+            or dpid in self._lost_deletes
+            or any(k[0] == dpid for k in self._pending)
+        )
+
     # -- metric seams (the Router counts through these so the counters
     # live beside the machinery they describe) ----------------------------
 
@@ -407,3 +426,7 @@ class RecoveryPlane:
     @staticmethod
     def note_resync() -> None:
         _m_resyncs.inc()
+
+    @staticmethod
+    def note_reconcile_deferred() -> None:
+        _m_reconcile_deferred.inc()

@@ -299,6 +299,17 @@ class Router:
         #: keeps the per-window cost at one attribute load + is-None
         #: test — the PR-4/7 unarmed hot-path contract.
         self.slo = None
+        #: fabric audit plane (ISSUE 15, control/audit.py): set by the
+        #: Controller when the southbound can answer flow stats. The
+        #: Router only ever asks it to verify a wiped switch.
+        self.audit = None
+        #: rate-shaped reconcile (ISSUE 15 satellite, carried from
+        #: PR 5): datapath-up reconciles past
+        #: Config.reconcile_max_per_flush park here (FIFO) and drain on
+        #: following recovery ticks — a power-cycled pod redialing at
+        #: once must not re-drive every desired set in one burst
+        self._reconcile_pending: list[int] = []
+        self._reconcile_spent = 0
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -1650,8 +1661,25 @@ class Router:
 
     def _datapath_up(self, event: ev.EventDatapathUp) -> None:
         self.dps.add(event.dpid)
-        if self.config.recovery_plane:
-            self._reconcile_datapath(event.dpid)
+        if not self.config.recovery_plane:
+            return
+        cap = self.config.reconcile_max_per_flush
+        if cap > 0 and self._reconcile_spent >= cap:
+            # mass-redial storm shaping (ISSUE 15 satellite): this
+            # flush window's reconcile budget is spent — park the
+            # reconcile; the anti-entropy tick drains the queue at the
+            # same cap. The switch serves from its (possibly stale or
+            # empty) table meanwhile; reconcile order is arrival order.
+            self.recovery.note_reconcile_deferred()
+            if event.dpid not in self._reconcile_pending:
+                self._reconcile_pending.append(event.dpid)
+            return
+        self._reconcile_spent += 1
+        if event.dpid in self._reconcile_pending:
+            # a parked switch bounced and redialed with budget free:
+            # this reconcile covers it — don't re-drive from the queue
+            self._reconcile_pending.remove(event.dpid)
+        self._reconcile_datapath(event.dpid)
 
     def _reconcile_datapath(self, dpid: int) -> None:
         """Re-drive a returning datapath's entire desired flow set.
@@ -1823,6 +1851,43 @@ class Router:
             verdict.sent = sorted(set(verdict.sent) - dropped)
         return verdict
 
+    # -- audit-plane heal seams (ISSUE 15; control/audit.py) ---------------
+
+    def audit_redrive(self, dpid: int, rows) -> None:
+        """Targeted repair of confirmed missing / counter-dead rows:
+        re-drive EXACTLY these desired rows (``[(src, dst, FlowSpec),
+        ...]``) through the reconcile install path — OF 1.0 ADD
+        replaces a corrupt entry in place, so one bad row costs one
+        row's re-install, never a wipe. Verdicts feed the same
+        recovery bookkeeping as any install."""
+        if dpid not in self.dps or not rows:
+            return
+        sp = start_span("audit_redrive", dpid=dpid, n_rows=len(rows))
+        try:
+            self.recovery.note_reconcile(len(rows))
+            verdict = self._send_desired(dpid, rows)
+            if self.config.recovery_plane:
+                self.recovery.note_send(verdict)
+        finally:
+            sp.end()
+
+    def audit_delete(self, dpid: int, rows) -> None:
+        """Targeted teardown of confirmed orphan rows (``[(src, dst),
+        ...]`` — rows the fabric holds that no desired state ever
+        recorded). A dropped teardown re-drives as a teardown through
+        the recovery plane's delete-carrying retry."""
+        if dpid not in self.dps or not rows:
+            return
+        sp = start_span("audit_delete", dpid=dpid, n_rows=len(rows))
+        try:
+            verdict = self._send_deletes(dpid, rows)
+            if self.config.recovery_plane:
+                self.recovery.note_send(
+                    verdict, delete_rows={dpid: set(rows)}
+                )
+        finally:
+            sp.end()
+
     def recovery_tick(self, now: float | None = None) -> None:
         """One anti-entropy pass (per EventStatsFlush — the Monitor's
         cadence, the same edge the utilization plane flushes on): expire
@@ -1833,6 +1898,19 @@ class Router:
         if not self.config.recovery_plane:
             return
         now = time.monotonic() if now is None else now
+        # a fresh flush window: the reconcile budget renews and the
+        # deferred-reconcile queue drains under the same cap, oldest
+        # first (rate-shaped mass-redial recovery, ISSUE 15 satellite)
+        self._reconcile_spent = 0
+        cap = self.config.reconcile_max_per_flush
+        while self._reconcile_pending and (
+            cap <= 0 or self._reconcile_spent < cap
+        ):
+            dpid = self._reconcile_pending.pop(0)
+            if dpid not in self.dps:
+                continue  # went away again; reconcile-on-up will re-queue
+            self._reconcile_spent += 1
+            self._reconcile_datapath(dpid)
         for dpid, (rows, resync) in self.recovery.expire_barriers(
             now, self.config.barrier_timeout_s
         ).items():
@@ -1923,6 +2001,12 @@ class Router:
             self.bus.publish(ev.EventDatapathUp(dpid))
         finally:
             sp.end()
+        if self.audit is not None:
+            # the escalation no longer trusts the wipe: the audit plane
+            # verifies this switch ahead of its round-robin turn on the
+            # next sweep (ISSUE 15 — the flow-stats-based table
+            # verification carried as an open item since PR 5)
+            self.audit.request_verify(dpid)
 
     def _effective_dst(self, dst: str) -> str | None:
         """The MAC a flow actually targets: for MPI flows the dst is a

@@ -116,6 +116,11 @@ class OFSouthbound:
         self._writers: dict[int, asyncio.StreamWriter] = {}
         self._ports: dict[int, set[int]] = {}
         self._stats: dict[int, list[of.PortStatsEntry]] = {}
+        #: dpid -> last fully-assembled OFPST_FLOW reply (the audit
+        #: plane's pull cache, same one-interval-lag contract as
+        #: port_stats) and the in-flight multipart part list
+        self._flow_stats: dict[int, list[of.FlowStatsEntry]] = {}
+        self._flow_parts: dict[int, list[bytes]] = {}
         self._cookie_flows: dict[int, list] = {}
         self._xid = 0
         #: dpid -> (xid, sent_at monotonic) of the outstanding echo
@@ -200,6 +205,8 @@ class OFSouthbound:
                 del self._writers[dpid]
                 self._ports.pop(dpid, None)
                 self._stats.pop(dpid, None)
+                self._flow_stats.pop(dpid, None)
+                self._flow_parts.pop(dpid, None)
                 self._echo_pending.pop(dpid, None)
                 if self.bus is not None:
                     self.bus.publish(EventDatapathDown(dpid))
@@ -291,6 +298,10 @@ class OFSouthbound:
             # down-path cleanup raced the redial, nothing) forever.
             if self._stats.pop(new_dpid, None) is not None:
                 _m_stale_stats.inc()
+            # same staleness rule for the flow-stats cache: a redialed
+            # switch's table restarted (or at least its counters did)
+            self._flow_stats.pop(new_dpid, None)
+            self._flow_parts.pop(new_dpid, None)
             self._echo_pending.pop(new_dpid, None)
             self._writers[new_dpid] = writer
             self._ports[new_dpid] = set(port_nos)
@@ -361,7 +372,21 @@ class OFSouthbound:
                     rec["byte_count"],
                 ))
         elif msg_type == ofwire.OFPT_STATS_REPLY:
-            self._stats[dpid] = ofwire.decode_port_stats_reply(msg)
+            stats_type, flags = ofwire.peek_stats_type(msg)
+            if stats_type == ofwire.OFPST_FLOW:
+                # MULTIPART: parts accumulate until REPLY_MORE clears,
+                # then the whole table decodes in one batched pass —
+                # a partial accumulation never serves as a table dump
+                # (the audit would read the missing tail as divergence)
+                parts = self._flow_parts.setdefault(dpid, [])
+                parts.append(msg)
+                if not flags & ofwire.OFPSF_REPLY_MORE:
+                    del self._flow_parts[dpid]
+                    self._flow_stats[dpid] = (
+                        ofwire.decode_flow_stats_reply(parts)
+                    )
+            else:
+                self._stats[dpid] = ofwire.decode_port_stats_reply(msg)
         elif msg_type == ofwire.OFPT_BARRIER_REPLY:
             # the end-to-end receipt of a batched install span: the
             # switch has processed everything sent before the barrier
@@ -575,6 +600,24 @@ class OFSouthbound:
             dpid, ofwire.encode_port_stats_request(xid=self._next_xid())
         )
         return self._stats.get(dpid, [])
+
+    def flow_stats(self, dpid: int):
+        """Last fully-assembled OFPST_FLOW table dump; kicks off the
+        next request (one-interval lag, like port_stats). Returns None
+        — not [] — before the first complete reply lands: the audit
+        plane must never read "no answer yet" as "empty table"."""
+        self._send(
+            dpid, ofwire.encode_flow_stats_request(xid=self._next_xid())
+        )
+        return self._flow_stats.get(dpid)
+
+    def invalidate_flow_stats(self, dpid: int) -> None:
+        """Drop the cached table dump (and any in-flight multipart):
+        the audit plane calls this when it KNOWS the table just changed
+        out from under the cache (a wipe-and-resync) — the one-interval
+        lag must not serve the pre-wipe dump as a post-wipe verify."""
+        self._flow_stats.pop(dpid, None)
+        self._flow_parts.pop(dpid, None)
 
     def connected_dpids(self) -> list[int]:
         return sorted(self._writers)

@@ -138,6 +138,10 @@ class TopologyManager:
         #: per EventStatsFlush once the utilization plane is bound;
         #: served over the bus / mirrored into the telemetry snapshot
         self.congestion: dict = {}
+        #: fabric audit plane (ISSUE 15; wired by the Controller): its
+        #: per-flow byte attribution becomes the congestion report's
+        #: measured-vs-modeled block. None = no measured column.
+        self.audit = None
 
     # -- bootstrap flows (reference: sdnmpi/topology.py:94-108) -----------
 
@@ -578,7 +582,7 @@ class TopologyManager:
             colls.sort(key=lambda c: -c["bps"])
         _m_hot_collectives.set(len(colls))
         oracle = getattr(self.topologydb, "_oracle", None)
-        return {
+        report = {
             "epoch": epoch,
             "top": hot,
             "collectives": colls,
@@ -594,6 +598,12 @@ class TopologyManager:
             # with a stale fractional bound
             "ratio": getattr(oracle, "last_congestion_ratio", 0.0),
         }
+        if self.audit is not None:
+            # measured-vs-modeled (ISSUE 15): the audit plane's per-flow
+            # byte attribution beside every install's modeled congestion
+            # — the fabric's observed truth against the scheduler's model
+            report["measured"] = self.audit.report()
+        return report
 
     def _port_stats(self, event: ev.EventPortStats) -> None:
         key = (event.dpid, event.port_no)

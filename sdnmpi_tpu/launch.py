@@ -167,6 +167,13 @@ def config_from_args(args) -> Config:
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
+        fabric_audit=not getattr(args, "no_fabric_audit", False),
+        audit_switches_per_flush=getattr(
+            args, "audit_switches_per_flush", 64
+        ),
+        reconcile_max_per_flush=getattr(
+            args, "reconcile_max_per_flush", 0
+        ),
         schedule_collectives=getattr(args, "schedule_phases", None)
         is not None,
         schedule_phases=getattr(args, "schedule_phases", None) or 0,
@@ -340,6 +347,10 @@ async def amain(args) -> None:
                 p_send_drop=0.05, p_send_stall=0.03, p_send_truncate=0.02,
                 p_ack_drop=0.03, p_stats_delay=0.1,
                 p_crash=0.05, p_redial=0.5, p_flap=0.08, p_restore=0.5,
+                # silent table corruption (ISSUE 15): watch the audit
+                # plane's divergence counters catch and heal it live
+                p_mutate=0.03,
+                mutate_priority=config.priority_default,
             ).attach(fabric)
             log.info("chaos fault plan armed (seed %d)", args.chaos)
 
@@ -605,6 +616,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--echo-timeout", type=float, default=45.0,
         help="seconds without an echo reply before a half-open "
         "datapath is disconnected",
+    )
+    parser.add_argument(
+        "--no-fabric-audit", action="store_true",
+        help="disable the fabric ground-truth audit plane "
+        "(control/audit.py): per-flush OFPST_FLOW sweeps diffing every "
+        "switch's actual table against the desired store, healing "
+        "confirmed divergence as targeted re-drives",
+    )
+    parser.add_argument(
+        "--audit-switches-per-flush", type=_nonneg_int, default=64,
+        metavar="N",
+        help="switches audited per Monitor flush (the sweep's "
+        "round-robin pacing; 0 = the whole fabric every flush)",
+    )
+    parser.add_argument(
+        "--reconcile-max-per-flush", type=_nonneg_int, default=0,
+        metavar="N",
+        help="cap datapath-up reconciles served per flush window so a "
+        "power-cycled pod redialing at once cannot flood the install "
+        "plane (deferred reconciles drain on later flushes, counted in "
+        "reconcile_deferred_total; 0 = unshaped)",
     )
     parser.add_argument(
         "--chaos", type=int, default=None, metavar="SEED",

@@ -238,3 +238,25 @@ class PortStatsEntry:
     rx_bytes: int
     tx_packets: int
     tx_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowStatsEntry:
+    """One installed flow's identity + cumulative counters — the
+    ofp_flow_stats record of an OFPST_FLOW reply. This is the fabric's
+    GROUND TRUTH row: what the switch actually holds, not what the
+    controller believes it installed. The audit plane (control/audit.py)
+    diffs lists of these against the DesiredFlowStore; the reference
+    never requested flow stats at all (its Monitor polls ports only,
+    sdnmpi/monitor.py:54-60), so installed-vs-desired agreement was
+    unverifiable there."""
+
+    match: Match
+    actions: tuple[Action, ...]
+    priority: int
+    duration_sec: int = 0
+    idle_timeout: int = 0
+    hard_timeout: int = 0
+    cookie: int = 0
+    packet_count: int = 0
+    byte_count: int = 0

@@ -23,6 +23,24 @@ def int_to_mac(value: int) -> str:
     return ":".join(raw[i : i + 2] for i in range(0, 12, 2))
 
 
+#: bounded int48 -> "aa:bb:.." memo behind :func:`int_to_mac_memo` —
+#: hot decode paths (flow-stats sweeps, phase-row indexes) re-
+#: materialize the same endpoint MACs constantly; the key space is the
+#: fabric's endpoints, but cap anyway
+_MAC_MEMO: dict = {}
+_MAC_MEMO_CAP = 1 << 16
+
+
+def int_to_mac_memo(value: int) -> str:
+    """Memoized :func:`int_to_mac` (bounded, process-wide)."""
+    s = _MAC_MEMO.get(value)
+    if s is None:
+        if len(_MAC_MEMO) >= _MAC_MEMO_CAP:
+            _MAC_MEMO.clear()
+        s = _MAC_MEMO[value] = int_to_mac(value)
+    return s
+
+
 def mac_to_bytes(mac: str) -> bytes:
     return bytes.fromhex(mac.replace(":", ""))
 

@@ -71,7 +71,29 @@ DEFAULT_TRACKS = (
     "device_memory_in_use_bytes",
     "coalescer_queue_depth",
     "pipeline_inflight_windows",
+    "fabric_divergence_total",
 )
+
+#: labeled-family -> timeline channel mapping (ISSUE 15 satellite).
+#: Plain counters/gauges map into rows by name and histograms by their
+#: ``_count``/``_sum`` figures automatically; a LABELED family's
+#: children carry ``name{label=value}`` names whose cardinality is the
+#: caller's contract, so each family must DECLARE how it flattens into
+#: one timeline channel — today "sum" (children aggregated; counters
+#: sum their values, histogram children their counts). The metrics-lint
+#: gate fails any labeled instrument registered without an entry here:
+#: a metric you cannot see on the timeline is a metric whose regression
+#: you cannot date.
+LABELED_CHANNELS = {
+    "admission_rejections_total": "sum",
+    "fabric_divergence_total": "sum",
+    "fabric_tenant_bytes_total": "sum",
+    "flight_anomalies_total": "sum",
+    "jit_compile_seconds": "sum",
+    "jit_traces_total": "sum",
+    "slo_burn_triggers_total": "sum",
+    "slo_route_latency_seconds": "sum",
+}
 
 
 class MetricsTimeline:
@@ -130,6 +152,21 @@ class MetricsTimeline:
         for name, h in hists.items():
             row[f"{name}_count"] = h["count"]
             row[f"{name}_sum"] = round(h["sum"], 6)
+        # labeled families flatten into their declared channel (one
+        # aggregate series per family beside the raw child series)
+        agg: dict[str, float] = {}
+        for name, v in counters.items():
+            if "{" in name:
+                base = name.split("{", 1)[0]
+                if base in LABELED_CHANNELS:
+                    agg[base] = agg.get(base, 0) + v
+        for name, h in hists.items():
+            if "{" in name:
+                base = name.split("{", 1)[0]
+                if base in LABELED_CHANNELS:
+                    key = f"{base}_count"
+                    agg[key] = agg.get(key, 0) + h["count"]
+        row.update(agg)
         # derived: interval p99 of the latency headliners
         for name in P99_SERIES:
             h = hists.get(name)

@@ -1,0 +1,785 @@
+"""Fabric ground-truth audit plane (ISSUE 15).
+
+OFPST_FLOW wire codecs (multipart, batched == scalar), SimSwitch /
+Fabric / OFSouthbound flow-stats plumbing, the AuditPlane's
+missing/orphan/counter-dead diff with confirm-then-heal, the seeded
+table-mutation chaos soak (sim + wire) with exact divergence
+accounting, the zero-false-positive churn-replay fence, the rate-shaped
+reconcile satellite, and desired-store checkpointing.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.control.faults import FaultPlan
+from sdnmpi_tpu.protocol import ofwire
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.topogen import fattree, linear
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _registry_reset():
+    yield
+    REGISTRY.reset()
+
+
+def divergence_counts() -> dict:
+    return dict(REGISTRY.get("fabric_divergence_total").values)
+
+
+def build(wire: bool = True, **overrides):
+    """A small fat-tree controller with the audit plane armed
+    full-fabric and a routed pair population."""
+    spec = fattree(4)
+    fabric = spec.to_fabric(wire=wire)
+    kwargs = dict(
+        coalesce_routes=True,
+        audit_switches_per_flush=0,
+        audit_confirm_sweeps=2,
+        install_retry_backoff_s=0.0,
+        barrier_timeout_s=0.0,
+    )
+    kwargs.update(overrides)
+    config = Config(**kwargs)
+    controller = Controller(fabric, config)
+    controller.attach()
+    assert controller.audit is not None
+    macs = sorted(fabric.hosts)
+    pairs = [(macs[i], macs[(i + 1) % len(macs)]) for i in range(8)]
+    controller.router.reinstall_pairs(pairs)
+    return fabric, controller, pairs
+
+
+def pump(fabric, pairs) -> None:
+    for src, dst in pairs:
+        fabric.hosts[src].send(of.Packet(src, dst, of.ETH_TYPE_IP))
+
+
+def sweep(controller, fabric, pairs, traffic: bool = True):
+    """One Monitor-flush edge (audit sweep + recovery tick + flight
+    trigger pass), with data-plane traffic first so counters tick."""
+    if traffic:
+        pump(fabric, pairs)
+    controller.bus.publish(ev.EventStatsFlush())
+
+
+def audited_installed(fabric, controller) -> set:
+    """(dpid, src, dst) of every audit-scope row the fabric holds."""
+    prio = controller.config.priority_default
+    return {
+        (d, e.match.dl_src, e.match.dl_dst)
+        for d, sw in fabric.switches.items()
+        for e in sw.flow_table
+        if e.priority == prio and e.match.dl_src is not None
+        and e.cookie == 0
+    }
+
+
+def desired_rows(controller) -> set:
+    return {
+        (d, s, t)
+        for d, table in controller.router.recovery.desired.flows.items()
+        for (s, t) in table
+    }
+
+
+# -- wire codec ------------------------------------------------------------
+
+
+class TestFlowStatsCodec:
+    def _entries(self, n: int = 200):
+        import random
+
+        rng = random.Random(11)
+        out = []
+        for i in range(n):
+            src = "02:00:00:00:%02x:%02x" % (i >> 8, i & 255)
+            dst = "02:00:00:01:%02x:%02x" % (i >> 8, i & 255)
+            kind = rng.randrange(4)
+            if kind == 0:
+                out.append(of.FlowStatsEntry(
+                    of.Match(dl_src=src, dl_dst=dst), (), 1 + i % 7,
+                ))
+            elif kind == 1:
+                out.append(of.FlowStatsEntry(
+                    of.Match(dl_src=src, dl_dst=dst),
+                    (of.ActionOutput(i % 65535),), 0x8000,
+                    duration_sec=i, packet_count=3 * i,
+                    byte_count=99 * i, cookie=i,
+                ))
+            elif kind == 2:
+                out.append(of.FlowStatsEntry(
+                    of.Match(dl_src=src, dl_dst=dst),
+                    (of.ActionSetDlDst(dst), of.ActionOutput(2)),
+                    0x8000, idle_timeout=30, hard_timeout=60,
+                ))
+            else:
+                # the bootstrap-rule shape: rich match, scalar path
+                out.append(of.FlowStatsEntry(
+                    of.Match(dl_type=0x0800, nw_proto=17, tp_dst=61000),
+                    (of.ActionOutput(of.OFPP_CONTROLLER),), 0xFFFF,
+                ))
+        return out
+
+    def test_round_trip_all_layouts(self):
+        entries = self._entries(24)
+        parts = ofwire.encode_flow_stats_reply(entries, xid=3)
+        assert len(parts) == 1
+        assert ofwire.decode_flow_stats_reply(parts) == entries
+
+    def test_batched_blob_matches_scalar_concatenation(self):
+        entries = self._entries(200)  # above the scalar threshold
+        blob, offsets = ofwire._flow_stats_blob(entries)
+        scalar = b"".join(
+            ofwire._encode_flow_stats_entry(e) for e in entries
+        )
+        assert blob == scalar
+        assert int(offsets[-1]) == len(blob)
+
+    def test_multipart_split_and_reassembly(self):
+        entries = self._entries(200)
+        parts = ofwire.encode_flow_stats_reply(
+            entries, xid=1, max_body=2048
+        )
+        assert len(parts) > 1
+        # every part but the last advertises more to come
+        for part in parts[:-1]:
+            assert ofwire.peek_stats_type(part) == (
+                ofwire.OFPST_FLOW, ofwire.OFPSF_REPLY_MORE
+            )
+        assert ofwire.peek_stats_type(parts[-1]) == (ofwire.OFPST_FLOW, 0)
+        # 16-bit length discipline: each part frames as one OF message
+        for part in parts:
+            _t, length, _x = ofwire.peek_header(part)
+            assert length == len(part) <= 65535
+        assert ofwire.decode_flow_stats_reply(parts) == entries
+
+    def test_empty_table_is_one_empty_part(self):
+        parts = ofwire.encode_flow_stats_reply([], xid=1)
+        assert len(parts) == 1
+        assert ofwire.decode_flow_stats_reply(parts) == []
+
+    def test_request_round_trip(self):
+        buf = ofwire.encode_flow_stats_request(xid=9)
+        assert ofwire.decode_flow_stats_request(buf) == (
+            of.Match(), 0xFF, of.OFPP_NONE
+        )
+
+    def test_trailing_garbage_rejected(self):
+        entries = self._entries(4)
+        (part,) = ofwire.encode_flow_stats_reply(entries, xid=1)
+        # extend the declared length over truncated record bytes
+        bad = bytearray(part + b"\x00" * 4)
+        import struct
+
+        struct.pack_into("!H", bad, 2, len(bad))
+        with pytest.raises(ValueError):
+            ofwire.decode_flow_stats_reply(bytes(bad))
+
+
+class TestSouthboundMultipart:
+    def test_parts_accumulate_until_more_clears(self):
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        entries = TestFlowStatsCodec()._entries(100)
+        parts = ofwire.encode_flow_stats_reply(
+            entries, xid=7, max_body=2048
+        )
+        assert len(parts) > 1
+        for part in parts[:-1]:
+            sb._dispatch(
+                ofwire.OFPT_STATS_REPLY, part, 7, dpid=5, writer=None
+            )
+            # incomplete multipart never serves as a table dump
+            assert 5 not in sb._flow_stats
+        sb._dispatch(
+            ofwire.OFPT_STATS_REPLY, parts[-1], 7, dpid=5, writer=None
+        )
+        assert sb._flow_stats[5] == entries
+        assert 5 not in sb._flow_parts
+
+
+# -- sim plumbing ----------------------------------------------------------
+
+
+class TestSimFlowStats:
+    @pytest.mark.parametrize("wire", [False, True])
+    def test_counters_tick_and_round_trip(self, wire):
+        fabric, controller, pairs = build(wire=wire)
+        pump(fabric, pairs)
+        pump(fabric, pairs)
+        dpid = next(iter(desired_rows(controller)))[0]
+        entries = fabric.flow_stats(dpid)
+        assert entries is not None
+        scope = [
+            e for e in entries
+            if e.priority == controller.config.priority_default
+            and e.match.dl_src is not None
+        ]
+        assert scope and any(e.packet_count > 0 for e in scope)
+        assert all(e.byte_count >= e.packet_count for e in scope)
+
+    def test_no_reply_is_none_not_empty(self):
+        fabric, controller, pairs = build(wire=False)
+        assert fabric.flow_stats(10**9) is None  # unknown dpid
+        plan = FaultPlan(seed=1, p_stats_delay=1.0).attach(fabric)
+        dpid = sorted(fabric.switches)[0]
+        assert fabric.flow_stats(dpid) is None  # delayed StatsReply
+        plan.active = False
+        assert fabric.flow_stats(dpid) is not None
+
+
+# -- detection + healing ---------------------------------------------------
+
+
+class TestAuditDetection:
+    @pytest.mark.parametrize(
+        "kind,expected",
+        [
+            ("drop_row", "missing"),
+            ("insert_row", "orphan"),
+            ("blackhole", "missing"),
+            ("freeze", "counter_dead"),
+        ],
+    )
+    def test_each_mutation_kind_detected_and_healed(self, kind, expected):
+        fabric, controller, pairs = build(wire=True)
+        sweep(controller, fabric, pairs)
+        sweep(controller, fabric, pairs)
+        plan = FaultPlan(
+            seed=5, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        rec = plan.mutate(kind=kind)
+        assert rec is not None and rec[1] == kind
+        for _ in range(5):
+            sweep(controller, fabric, pairs)
+        assert divergence_counts() == {expected: 1}
+        assert audited_installed(fabric, controller) == desired_rows(
+            controller
+        )
+        # healed for real: no blackholed or frozen entries survive
+        for sw in fabric.switches.values():
+            for e in sw.flow_table:
+                if e.match.dl_src and e.cookie == 0:
+                    assert e.actions != () and not e.frozen
+
+    def test_detection_latency_at_most_confirm_sweeps(self):
+        fabric, controller, pairs = build(wire=True)
+        sweep(controller, fabric, pairs)
+        plan = FaultPlan(
+            seed=6, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        plan.mutate(kind="drop_row")
+        controller.audit.sweep()  # sweep 1: suspect
+        assert divergence_counts() == {}
+        controller.audit.sweep()  # sweep 2: confirmed (<= 2 periods)
+        assert divergence_counts() == {"missing": 1}
+
+    def test_transient_suspicion_clears_itself(self):
+        """A divergence that disappears before confirmation (the row
+        reappears — an install racing the sweep) never counts."""
+        fabric, controller, pairs = build(wire=False)
+        sweep(controller, fabric, pairs)
+        dpid, src, dst = next(iter(desired_rows(controller)))
+        sw = fabric.switches[dpid]
+        doomed = next(
+            e for e in sw.flow_table
+            if e.match.dl_src == src and e.match.dl_dst == dst
+        )
+        spec = controller.router.recovery.desired.flows[dpid][(src, dst)]
+        sw.drop_entries({id(doomed)})
+        controller.audit.sweep()  # suspect
+        # the row comes back before the confirming sweep
+        actions: tuple = (of.ActionOutput(spec.out_port),)
+        if spec.rewrite:
+            actions = (of.ActionSetDlDst(spec.rewrite),) + actions
+        sw.flow_mod(of.FlowMod(
+            of.Match(dl_src=src, dl_dst=dst), actions,
+            controller.config.priority_default,
+        ))
+        controller.audit.sweep()
+        controller.audit.sweep()
+        assert divergence_counts() == {}
+
+    def test_in_flight_recovery_skips_audit(self):
+        fabric, controller, pairs = build(wire=False)
+        sweep(controller, fabric, pairs)
+        dpid = next(iter(desired_rows(controller)))[0]
+        # park recovery state for the dpid: the audit must step aside
+        controller.router.recovery.schedule(dpid, now=0.0)
+        skipped = REGISTRY.get("audit_switches_skipped_total").value
+        controller.audit.sweep()
+        assert REGISTRY.get("audit_switches_skipped_total").value > skipped
+
+    def test_resync_requests_verify_sweep(self):
+        fabric, controller, pairs = build(wire=False)
+        sweep(controller, fabric, pairs)
+        dpid = next(iter(desired_rows(controller)))[0]
+        controller.router._resync_datapath(dpid)
+        assert dpid in controller.audit._verify
+        controller.audit.sweep()
+        assert controller.audit._verify == set()
+
+    def test_skipped_verify_request_requeues(self):
+        """A verify owed to a wiped switch survives a skipped audit
+        (recovery mid-air): the wipe is verified LATER, never silently
+        trusted after all."""
+        fabric, controller, pairs = build(wire=False)
+        sweep(controller, fabric, pairs)
+        dpid = next(iter(desired_rows(controller)))[0]
+        controller.audit.request_verify(dpid)
+        controller.router.recovery.schedule(dpid, now=0.0)  # in flight
+        controller.audit.sweep()
+        assert dpid in controller.audit._verify  # re-queued, not lost
+        controller.router.recovery.succeed(dpid)
+        controller.router.recovery.pop_due(10.0)
+        controller.audit.sweep()
+        assert dpid not in controller.audit._verify
+
+    def test_verify_queue_respects_pacing_cap(self):
+        """A mass resync's verify queue drains under the per-flush cap
+        instead of bursting one full-fabric sweep."""
+        fabric, controller, pairs = build(wire=False)
+        controller.config.audit_switches_per_flush = 4
+        for d in sorted(fabric.switches):
+            controller.audit.request_verify(d)
+        n = len(fabric.switches)
+        controller.audit.sweep()
+        assert len(controller.audit._verify) == n - 4
+        controller.audit.sweep()
+        assert len(controller.audit._verify) == n - 8
+
+    def test_request_verify_drops_cached_southbound_dump(self):
+        """A caching southbound's one-interval-lag dump must not serve
+        as a post-wipe verify."""
+        from sdnmpi_tpu.control.southbound import OFSouthbound
+
+        sb = OFSouthbound()
+        sb._flow_stats[7] = []
+        sb._flow_parts[7] = [b"x"]
+
+        class _Audit:
+            from sdnmpi_tpu.control.audit import AuditPlane
+            request_verify = AuditPlane.request_verify
+
+            def __init__(self, southbound):
+                self.southbound = southbound
+                self._verify = set()
+
+        _Audit(sb).request_verify(7)
+        assert 7 not in sb._flow_stats and 7 not in sb._flow_parts
+
+    def test_traffic_cessation_is_not_counter_dead(self):
+        """With audit_confirm_sweeps=1 (immediate table-kind confirms)
+        counter-dead still floors at two sightings: a pair whose
+        traffic simply STOPPED must not page as fabric divergence."""
+        fabric, controller, pairs = build(
+            wire=False, audit_confirm_sweeps=1
+        )
+        for _ in range(3):
+            sweep(controller, fabric, pairs)  # traffic flowing
+        # traffic stops dead; rows stay installed and healthy
+        for _ in range(3):
+            sweep(controller, fabric, pairs, traffic=False)
+        assert divergence_counts() == {}
+
+    def test_pair_dicts_prune_past_detector_horizon(self):
+        """_pair_epoch/_pair_gap age out once the cycle clock moves two
+        full passes past them — endpoint churn cannot grow them forever."""
+        fabric, controller, pairs = build(wire=False)
+        for _ in range(2):
+            sweep(controller, fabric, pairs)
+        assert controller.audit._pair_epoch
+        for _ in range(4):  # cycles advance with no fresh advancement
+            sweep(controller, fabric, pairs, traffic=False)
+        assert controller.audit._pair_epoch == {}
+        assert controller.audit._pair_gap == {}
+
+    def test_departed_switch_prunes_audit_state(self):
+        """A switch that confirms divergence and then crashes for good
+        must not pin the diverged gauge (or its baselines) forever."""
+        fabric, controller, pairs = build(wire=False)
+        sweep(controller, fabric, pairs)
+        plan = FaultPlan(
+            seed=8, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        rec = plan.mutate(kind="insert_row")
+        for _ in range(3):
+            sweep(controller, fabric, pairs)
+        # force a lasting diverged mark, then kill the switch for good
+        controller.audit._diverged.add(rec[0])
+        fabric.faults = None
+        fabric.crash_switch(rec[0])
+        controller.audit.sweep()
+        assert rec[0] not in controller.audit._diverged
+        assert rec[0] not in controller.audit._counters
+        assert REGISTRY.get("fabric_diverged_switches").value == 0
+
+    def test_bundle_names_switch_and_rows(self):
+        fabric, controller, pairs = build(wire=True)
+        sweep(controller, fabric, pairs)
+        plan = FaultPlan(
+            seed=7, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        rec = plan.mutate(kind="drop_row")
+        for _ in range(3):
+            sweep(controller, fabric, pairs)
+        bundles = [
+            b for b in controller.flight.bundles
+            if b["trigger"] == "fabric:divergence"
+        ]
+        assert bundles
+        recent = bundles[0]["detail"]["recent"]
+        assert any(
+            r["dpid"] == rec[0]
+            and f"{rec[2][0]}>{rec[2][1]}" in r["rows"]
+            for r in recent
+        )
+        # the audit context provider rode the bundle
+        assert "audit" in bundles[0]
+
+
+# -- seeded table-mutation chaos soak --------------------------------------
+
+
+class TestMutationSoak:
+    EXPECT_KIND = {
+        "drop_row": "missing",
+        "insert_row": "orphan",
+        "blackhole": "missing",
+        "freeze": "counter_dead",
+    }
+
+    @pytest.mark.parametrize("wire", [False, True])
+    def test_every_mutation_detected_attributed_healed(self, wire):
+        fabric, controller, pairs = build(wire=wire)
+        sweep(controller, fabric, pairs)
+        plan = FaultPlan(
+            seed=42, p_mutate=0.5,
+            mutate_priority=controller.config.priority_default,
+        ).attach(fabric)
+        for _ in range(24):
+            plan.step()
+            sweep(controller, fabric, pairs)
+        assert plan.mutations, "the seeded plan must actually mutate"
+        plan.quiesce()
+        # run the audit to convergence: sweeps with traffic until every
+        # injected mutation is detected and healed
+        for _ in range(12):
+            sweep(controller, fabric, pairs)
+            if sum(divergence_counts().values()) >= len(plan.mutations):
+                break
+        sweep(controller, fabric, pairs)
+        want: dict[str, int] = {}
+        for _dpid, kind, _row in plan.mutations:
+            k = self.EXPECT_KIND[kind]
+            want[k] = want.get(k, 0) + 1
+        # EXACT accounting: one confirmed divergence per injected
+        # mutation, none extra (zero false positives under the soak)
+        assert divergence_counts() == want
+        # healed: installed == desired on the audit scope, no
+        # blackholed/frozen survivors, every bundle-named row real
+        assert audited_installed(fabric, controller) == desired_rows(
+            controller
+        )
+        for sw in fabric.switches.values():
+            for e in sw.flow_table:
+                if e.match.dl_src and e.cookie == 0:
+                    assert e.actions != () and not e.frozen
+        # every mutation was NAMED: the audit ledger carries (switch,
+        # rows) for each, and the flight bundles (bounded ring — late
+        # confirmations only) name theirs the same way
+        named = {
+            (r["dpid"], row)
+            for r in controller.audit.recent
+            for row in r["rows"]
+        }
+        for dpid, _kind, (src, dst) in plan.mutations:
+            assert (dpid, f"{src}>{dst}") in named
+        bundles = [
+            b for b in controller.flight.bundles
+            if b["trigger"] == "fabric:divergence"
+        ]
+        assert bundles
+        assert all(
+            r["rows"] for b in bundles for r in b["detail"]["recent"]
+        )
+
+
+class TestCleanChurnReplay:
+    def test_250_step_churn_stays_divergence_free(self):
+        """The zero-false-positive fence: 250 seeded steps of link
+        flaps/restores + stall chaos with live traffic and an audit
+        sweep per step — the divergence counters never move while flows
+        churn (reval teardown/reinstall, cache invalidation, counter
+        resets all look like ordinary life to the audit)."""
+        fabric, controller, pairs = build(wire=False)
+        plan = FaultPlan(
+            seed=13, p_flap=0.12, p_restore=0.5,
+            p_send_stall=0.02, p_release=0.7,
+        ).attach(fabric)
+        for step in range(250):
+            plan.step()
+            sweep(controller, fabric, pairs)
+            assert divergence_counts() == {}, f"false positive @ {step}"
+        plan.quiesce()
+        for _ in range(3):
+            sweep(controller, fabric, pairs)
+        assert divergence_counts() == {}
+        assert REGISTRY.get("audit_sweeps_total").value >= 250
+
+
+# -- attribution -----------------------------------------------------------
+
+
+class TestAttribution:
+    def test_tenant_bytes_roll_up_by_admission_group(self):
+        fabric, controller, pairs = build(wire=True)
+        tenant_pairs = pairs[:2]
+        for src, _dst in tenant_pairs:
+            controller.router.admission.assign(src, "tenant-a")
+        sweep(controller, fabric, pairs)  # baseline
+        sweep(controller, fabric, pairs)  # deltas attribute
+        fam = dict(REGISTRY.get("fabric_tenant_bytes_total").values)
+        assert fam.get("tenant-a", 0) > 0
+        assert fam.get("-", 0) > 0  # unregistered sources pool
+
+    def test_collective_measured_vs_modeled_in_congestion_report(self):
+        from sdnmpi_tpu.control.loadgen import register_ranks
+        from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+        fabric, controller, pairs = build(
+            wire=False,
+            schedule_collectives=True,
+            block_install_threshold=2,
+        )
+        macs = sorted(fabric.hosts)[:4]
+        ranks = register_ranks(fabric, controller.config, macs)
+        vmac = VirtualMac(
+            CollectiveType.ALLTOALL, ranks[0], ranks[1]
+        ).encode()
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=vmac,
+                      eth_type=of.ETH_TYPE_IP),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        installs = list(controller.router.collectives)
+        assert installs and installs[0].phase_rows is not None
+        cookie = installs[0].cookie
+        # drive MPI member traffic over the installed phase rows
+        from sdnmpi_tpu.protocol.vmac import encode_batch_ints  # noqa: F401
+
+        mpi_pairs = [
+            (macs[int(s)], VirtualMac(
+                CollectiveType.ALLTOALL, ranks[int(s)], ranks[int(d)]
+            ).encode())
+            for s, d in zip(installs[0].src_idx, installs[0].dst_idx)
+        ]
+        sweep(controller, fabric, mpi_pairs)  # baseline
+        sweep(controller, fabric, mpi_pairs)  # attribute deltas
+        measured = controller.audit.report()
+        by_cookie = {
+            c["cookie"]: c for c in measured["collectives"]
+        }
+        assert by_cookie[cookie]["measured_bytes"] > 0
+        assert by_cookie[cookie]["modeled_congestion"] >= 0.0
+        # the assembled congestion report carries the measured block
+        tm = controller.topology_manager
+        report = tm._assemble_congestion([], epoch=0)
+        assert report["measured"]["collectives"]
+
+
+# -- rate-shaped reconcile (satellite) -------------------------------------
+
+
+class TestRateShapedReconcile:
+    def test_mass_redial_defers_past_cap(self):
+        # arm the cap AFTER boot: the attach-time dial-in of the whole
+        # fabric is not the storm under test
+        fabric, controller, pairs = build(wire=False)
+        controller.config.reconcile_max_per_flush = 1
+        controller.router.recovery_tick(0.0)  # fresh budget window
+        passes = REGISTRY.get("reconcile_passes_total")
+        deferred = REGISTRY.get("reconcile_deferred_total")
+        victims = sorted(
+            d for d, table in
+            controller.router.recovery.desired.flows.items()
+        )[:3]
+        for d in victims:
+            fabric.crash_switch(d)
+        p_baseline = passes.value
+        d_baseline = deferred.value
+        for d in victims:
+            fabric.redial_switch(d)
+        # only ONE reconcile ran at redial time; the rest deferred FIFO
+        assert passes.value == p_baseline + 1
+        assert deferred.value == d_baseline + len(victims) - 1
+        assert len(controller.router._reconcile_pending) == 2
+        # flush windows drain the queue one per tick
+        controller.router.recovery_tick(1.0)
+        assert passes.value == p_baseline + 2
+        controller.router.recovery_tick(2.0)
+        assert passes.value == p_baseline + 3
+        assert controller.router._reconcile_pending == []
+        # fully reconciled: parity holds
+        assert audited_installed(fabric, controller) == desired_rows(
+            controller
+        )
+
+    def test_unshaped_default_reconciles_immediately(self):
+        fabric, controller, pairs = build(wire=False)
+        passes = REGISTRY.get("reconcile_passes_total")
+        victims = sorted(
+            d for d in controller.router.recovery.desired.flows
+        )[:3]
+        for d in victims:
+            fabric.crash_switch(d)
+        p0 = passes.value
+        for d in victims:
+            fabric.redial_switch(d)
+        assert passes.value >= p0 + len(victims)
+        assert REGISTRY.get("reconcile_deferred_total").value == 0
+
+
+# -- desired-store checkpointing (satellite) -------------------------------
+
+
+class TestDesiredCheckpoint:
+    def test_snapshot_restores_desired_rows_digest_guarded(self):
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+
+        fabric, controller, pairs = build(wire=False)
+        snap = snapshot_controller(controller)
+        rows = snap["desired_flows"]["rows"]
+        assert rows and all(len(r) == 6 for r in rows)
+        # a marker row proves restore reads the SNAPSHOT, not just the
+        # reinstall pass
+        marker = [rows[0][0], "02:aa:aa:aa:aa:aa", "02:bb:bb:bb:bb:bb",
+                  3, None, False]
+        snap["desired_flows"]["rows"].append(marker)
+
+        spec2 = fattree(4)
+        fabric2 = spec2.to_fabric(wire=False)
+        c2 = Controller(fabric2, controller.config)
+        c2.attach()
+        restore_controller(c2, snap)
+        assert c2.router.recovery.desired.has(
+            marker[0], marker[1], marker[2]
+        )
+
+        # digest mismatch (a different fabric): nothing restores from
+        # the snapshot's desired rows
+        fabric3 = linear(4).to_fabric(wire=False)
+        c3 = Controller(fabric3, controller.config)
+        c3.attach()
+        restore_controller(c3, snap)
+        assert not c3.router.recovery.desired.has(
+            marker[0], marker[1], marker[2]
+        )
+
+    def test_restarted_controller_audits_the_fabric_it_left(self):
+        """The PR-5 carried item end to end: snapshot, controller dies,
+        the fabric drifts while it is down (a bogus row appears), the
+        restarted controller restores the desired store and its audit
+        sweeps detect + heal the drift instead of trusting the warm
+        tables."""
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+
+        fabric, controller, pairs = build(wire=False)
+        snap = snapshot_controller(controller)
+        # drift while the controller is down: an orphan row appears
+        plan = FaultPlan(
+            seed=3, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        rec = plan.mutate(kind="insert_row")
+        fabric.faults = None
+
+        c2 = Controller(fabric, controller.config)
+        fabric.connect(c2.bus)
+        restore_controller(c2, snap)
+        for _ in range(4):
+            pump(fabric, pairs)
+            c2.audit.sweep()
+        counts = divergence_counts()
+        assert counts.get("orphan", 0) >= 1
+        dpid, _kind, (src, dst) = rec
+        assert not any(
+            e.match.dl_src == src and e.match.dl_dst == dst
+            for e in fabric.switches[dpid].flow_table
+        )
+
+
+# -- timeline channel + bench fence ----------------------------------------
+
+
+class TestTimelineChannel:
+    def test_labeled_families_aggregate_into_rows(self):
+        from sdnmpi_tpu.utils.timeline import MetricsTimeline
+
+        fam = REGISTRY.labeled_counter(
+            "fabric_divergence_total", "kind", ""
+        )
+        fam.inc("missing", 2)
+        fam.inc("orphan", 1)
+        t = MetricsTimeline(maxlen=8)
+        row = t.tick()
+        assert row["fabric_divergence_total"] == 3
+
+    def test_lint_rejects_unmapped_labeled_family(self):
+        from benchmarks.metrics_lint import run_metrics_lint
+
+        REGISTRY.labeled_counter("zz_unmapped_family_total", "who", "")
+        try:
+            errors = run_metrics_lint("README.md", do_soak=False)
+            assert any(
+                "zz_unmapped_family_total" in e
+                and "timeline channel" in e
+                for e in errors
+            )
+        finally:
+            REGISTRY._metrics.pop("zz_unmapped_family_total", None)
+
+
+class TestConfig16Fence:
+    def test_bench_machinery_at_test_scale(self):
+        from benchmarks.config16_audit import (
+            build as bench_build,
+            sweep_walls_ms,
+            targeted_repair_ms,
+            wipe_resync_ms,
+        )
+
+        spec, fabric, controller, pairs = bench_build(k=4, n_pairs=24)
+        walls = sweep_walls_ms(controller, fabric, pairs, n_sweeps=3)
+        assert len(walls) == 3 and all(w > 0 for w in walls)
+        plan = FaultPlan(
+            seed=16, mutate_priority=controller.config.priority_default
+        ).attach(fabric)
+        repair = targeted_repair_ms(controller, fabric, pairs, plan)
+        assert repair > 0 and len(plan.mutations) > 0
+        wipe = wipe_resync_ms(controller, fabric)
+        assert wipe > 0
+        # after everything, the bench leaves a convergent fabric
+        assert audited_installed(fabric, controller) == desired_rows(
+            controller
+        )
+
+    def test_registered_in_suite(self):
+        from benchmarks.run import CONFIGS
+
+        assert any(name == "16" for name, _cmd in CONFIGS)
