@@ -44,9 +44,19 @@ def snapshot_controller(controller) -> dict:
     from sdnmpi_tpu.oracle.routecache import RouteCache
 
     desired = controller.router.recovery.desired
+    # the hier oracle's lazy border-distance row plane rides beside the
+    # route-cache memo (ISSUE 18): digest-guarded inside the oracle, so
+    # a restarted controller inherits the warm level-2 plane instead of
+    # re-sweeping it. None when the hier oracle (or the knob) is off.
+    cfg = getattr(controller.topology_manager, "config", None)
+    hier_border = (
+        db.hier_border_snapshot()
+        if getattr(cfg, "hier_snapshot", True) else None
+    )
     return {
         "version": SNAPSHOT_VERSION,
         "route_cache": route_cache,
+        "hier_border": hier_border,
         "desired_flows": {
             "topology_digest": RouteCache.topology_digest(db),
             "rows": [
@@ -145,6 +155,17 @@ def restore_controller(controller, snapshot: dict) -> None:
     memo = snapshot.get("route_cache")
     if memo and db.route_cache is not None:
         db.route_cache.restore_entries(memo, db)
+
+    # The hier border plane restores BEFORE reinstall_pairs re-drives
+    # routes (the same ordering rule PR 13 pinned for the route-cache
+    # memo): the re-routing below then composes against the restored
+    # rows instead of re-sweeping them. Digest/version mismatches
+    # degrade to the cold lazy build inside the oracle (counted
+    # hier_snapshot_rejected_total), never a crash.
+    border = snapshot.get("hier_border")
+    cfg = getattr(controller.topology_manager, "config", None)
+    if border and getattr(cfg, "hier_snapshot", True):
+        db.hier_restore_border_rows(border)
 
     # Flows are restored by *re-routing* the snapshotted (src, dst) pairs
     # and pushing real FlowMods to whatever datapaths are currently live —

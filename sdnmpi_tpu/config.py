@@ -152,6 +152,23 @@ class Config:
     #: annotation (0 = ~sqrt(V) auto — balances pod blocks against the
     #: border skeleton)
     hier_pod_target: int = 0
+    #: fused hier serving path (ISSUE 18): composition (the three-way
+    #: min + border steering) runs as ONE jitted kernel over the
+    #: concatenated border-row plane, and paths materialize through
+    #: the batched host walk (oracle/hierpath.py) instead of per-pair
+    #: chases. Bit-identical routes either way (fenced); False is the
+    #: scalar escape hatch. No CLI flag — config/TopologyDB knob only.
+    hier_fused: bool = True
+    #: precompile the hier pow2 program ladder (row-sweep rungs +
+    #: composition buckets) during warm_serving, so steady hier serving
+    #: never traces (ISSUE 18; pairs with ``warm_serving`` and the
+    #: persistent compile cache)
+    hier_warm: bool = True
+    #: persist the hier border-distance row plane through api/snapshot
+    #: beside the route-cache memo (topology-digest guarded on
+    #: restore); a restarted controller inherits the warm level-2
+    #: plane instead of re-sweeping it
+    hier_snapshot: bool = True
     #: rank-pair count at or above which a proactive collective install
     #: uses the array-native block path (int MAC keys, shared
     #: FlowPathBlocks, one event per collective) instead of the

@@ -76,6 +76,8 @@ class TopologyManager:
             route_cache_max_entries=config.route_cache_max_entries,
             hier_oracle=config.hier_oracle,
             hier_pod_target=config.hier_pod_target,
+            hier_fused=config.hier_fused,
+            hier_warm=config.hier_warm,
         )
         #: (src_dpid, src_port) -> latest utilization of that directed
         #: link in bps: max of the sender's tx stream and the receiver's

@@ -241,6 +241,8 @@ class TopologyDB:
         route_cache_max_entries: int = 4096,
         hier_oracle: bool = False,
         hier_pod_target: int = 0,
+        hier_fused: bool = True,
+        hier_warm: bool = True,
     ) -> None:
         # dpid -> switch entity
         self.switches: dict[int, Any] = {}
@@ -272,6 +274,14 @@ class TopologyDB:
         #: partitioner pod-size target when the topology carries no
         #: PodMap annotation (0 = ~sqrt(V) auto)
         self.hier_pod_target = hier_pod_target
+        #: fused hier composition + batched path builder (ISSUE 18,
+        #: Config.hier_fused): one jitted kernel over the concatenated
+        #: border-row plane replaces the per-pod program chains.
+        #: Bit-identical either way; False is the scalar escape hatch.
+        self.hier_fused = hier_fused
+        #: precompile the hier pow2 program ladder during warm_serving
+        #: (ISSUE 18, Config.hier_warm)
+        self.hier_warm = hier_warm
         #: pod structure annotation (topogen/podmap.py): set by
         #: TopoSpec.to_topology_db for generator fabrics, None for
         #: discovered/hand-built graphs (the hier oracle partitions
@@ -855,6 +865,24 @@ class TopologyDB:
             return {"warm_s": 0.0, "shapes": [], "max_len": 0}
         return self._jax_oracle().warm_serving(self, shapes)
 
+    def hier_border_snapshot(self) -> Optional[dict]:
+        """Serializable snapshot of the hier oracle's materialized
+        border-row plane (ISSUE 18; None when the hier oracle is off,
+        stale, or has no rows) — api/snapshot persists it beside the
+        route-cache memo."""
+        if not self.hier_oracle or self.backend != "jax":
+            return None
+        return self._jax_oracle().border_snapshot(self)
+
+    def hier_restore_border_rows(self, snap) -> int:
+        """Seed the hier oracle's border-row plane from a snapshot
+        (topology-digest guarded: a mismatch counts
+        ``hier_snapshot_rejected_total`` and degrades to the cold lazy
+        build, never a crash). Returns the restored row count."""
+        if not self.hier_oracle or self.backend != "jax":
+            return 0
+        return self._jax_oracle().restore_border_rows(snap, self)
+
     # -- backend dispatch ------------------------------------------------
 
     def _shortest_route(self, src_dpid: int, dst_dpid: int) -> list[int]:
@@ -886,6 +914,8 @@ class TopologyDB:
                     shard_oracle=self.shard_oracle,
                     ring_exchange=self.ring_exchange,
                     pod_target=self.hier_pod_target,
+                    fused=self.hier_fused,
+                    hier_warm=self.hier_warm,
                 )
             else:
                 from sdnmpi_tpu.oracle.engine import RouteOracle

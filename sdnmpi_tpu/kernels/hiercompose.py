@@ -1,0 +1,99 @@
+"""Fused hierarchical composition kernel (ISSUE 18).
+
+The hierarchical oracle's per-query cost used to be a host numpy chain:
+one ``rows_p`` gather **per destination pod** in a Python loop, then
+the three-way min
+
+    total(q) = min over (b1, b2) of  dA(s, b1) + D(b1, b2) + dB(b2, d)
+
+and a second pass replicating the utilization tie-break. At the
+datacenter shape (config 15: 128 ranks spread over ~1000 pods) that
+loop runs ~1000 gathers per route window — the steady-route wall the
+ISSUE 18 targets call out. This module fuses the whole composition —
+cross-plane gather, three-way add, min, steering tie-break — into ONE
+jitted program over the *concatenated* border-row plane:
+
+- ``plane`` ``[R, B]`` f32 — every materialized destination pod's
+  border-distance rows, concatenated pod-major (``HierState`` keeps
+  the host mirror and a device twin; R is pow2-capped so growth
+  recompiles O(log B) times, never per shape);
+- ``rowidx`` ``[m, bB]`` int32 — per query, the plane row of each
+  destination-pod border (invalid slots clamped; the inf-padded
+  ``dbd`` masks them exactly like the host path's ``validB``);
+- ``gidA`` ``[m, bA]`` int32 — source-pod border ids (clamped pads,
+  masked by the inf-padded ``dsb``).
+
+Bit-identity with the host composition is a hard contract
+(tests/test_hier.py fences fused vs. escape-hatch routes): elementwise
+f32 adds are order-free, ``min`` reductions are order-free, and the
+tie-break reproduces ``np.argmax(is_best & (score == score.min()))``
+verbatim — ``jnp.argmax`` over bool picks the first True, the same
+lowest-(b1, b2) winner as the host path, and zero load planes make the
+unsteered pick collapse to ``argmax(is_best)`` exactly. All shapes
+arrive pow2-bucketed from the composer, so the trace space is
+O(log pods) and ``HierOracle.warm_serving`` can precompile the whole
+ladder at launch (count_trace-probed: zero recompiles after warm).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _compose_core(plane, rowidx, gidA, dsb, dbd, loadA, loadB):
+    from sdnmpi_tpu.utils.tracing import count_trace
+
+    count_trace("hier_compose")
+    # cross[q, i, j] = D(border gidA[q, i] -> dest border j of q's pod)
+    cross = plane[rowidx[:, None, :], gidA[:, :, None]]
+    tot = cross + dsb[:, :, None] + dbd[:, None, :]
+    m = tot.shape[0]
+    flat = tot.reshape(m, -1)
+    best = flat.min(axis=1)
+    is_best = flat == best[:, None]
+    score = jnp.where(
+        is_best,
+        (loadA[:, :, None] + loadB[:, None, :]).reshape(m, -1),
+        jnp.inf,
+    )
+    pick = jnp.argmax(
+        is_best & (score == score.min(axis=1)[:, None]), axis=1
+    ).astype(jnp.int32)
+    return best, pick
+
+
+@functools.lru_cache(maxsize=None)
+def _compose_jit():
+    return jax.jit(_compose_core)
+
+
+def compose_fused(plane, rowidx, gidA, dsb, dbd, loadA, loadB):
+    """One fused composition dispatch -> host ``(best [m] f32,
+    pick [m] int32)``. ``plane`` may be a device array (the state's
+    resident twin — no per-call upload) or a host array; everything
+    else is small per-chunk host data. ``pick`` decodes against the
+    PADDED bB (``pick // bB_pad, pick % bB_pad``)."""
+    best, pick = _compose_jit()(
+        plane, jnp.asarray(rowidx), jnp.asarray(gidA),
+        jnp.asarray(dsb), jnp.asarray(dbd),
+        jnp.asarray(loadA), jnp.asarray(loadB),
+    )
+    return np.asarray(best), np.asarray(pick)
+
+
+def warm_compose(plane, m: int, bA: int, bB: int) -> None:
+    """Trace/compile the composition at one (m, bA, bB) bucket against
+    ``plane`` — the warm-ladder entry point. Dummy inf operands: the
+    program compiles and runs in microseconds, and a later real
+    dispatch at the same bucket is a cache hit."""
+    inf = np.full((m, bA), np.inf, np.float32)
+    infB = np.full((m, bB), np.inf, np.float32)
+    zi = np.zeros((m, bB), np.int32)
+    za = np.zeros((m, bA), np.int32)
+    best, pick = compose_fused(plane, zi, za, inf, infB, inf, infB)
+    del best, pick

@@ -164,6 +164,8 @@ def config_from_args(args) -> Config:
         ring_exchange=getattr(args, "ring_exchange", False),
         hier_oracle=getattr(args, "hier_oracle", False),
         hier_pod_target=getattr(args, "hier_pod_target", 0),
+        hier_warm=getattr(args, "hier_warm", True),
+        hier_snapshot=getattr(args, "hier_snapshot", True),
         event_log=args.event_log or "",
         event_log_max_bytes=getattr(args, "event_log_max_bytes", 0),
         recovery_plane=not getattr(args, "no_recovery", False),
@@ -563,6 +565,36 @@ def build_parser() -> argparse.ArgumentParser:
         help="partitioner pod-size target for unannotated fabrics "
         "under --hier-oracle (0 = ~sqrt(V) auto)",
     )
+    parser.add_argument(
+        "--hier-warm", dest="hier_warm", action="store_true",
+        help="precompile the full hierarchical program ladder "
+        "(pod-stack APSP buckets, pow2 Jacobi pull-sweep shapes, fused "
+        "composition, batch fdb) during warm_serving, so the first "
+        "route after boot replays cached executables instead of "
+        "tracing (default: on)",
+    )
+    parser.add_argument(
+        "--no-hier-warm", dest="hier_warm", action="store_false",
+        help="skip the hierarchical warm ladder — first route pays "
+        "full trace/compile cost (the differential escape hatch; "
+        "routes stay bit-identical)",
+    )
+    parser.set_defaults(hier_warm=True)
+    parser.add_argument(
+        "--hier-snapshot", dest="hier_snapshot", action="store_true",
+        help="persist the hier oracle's lazy border-distance row plane "
+        "through api/snapshot beside the route-cache memo — a "
+        "restarted controller inherits the warm level-2 plane "
+        "(topology-digest guarded; default: on)",
+    )
+    parser.add_argument(
+        "--no-hier-snapshot", dest="hier_snapshot",
+        action="store_false",
+        help="exclude the border plane from checkpoints and ignore it "
+        "on restore — restart pays the cold lazy rebuild (the "
+        "differential escape hatch; routes stay bit-identical)",
+    )
+    parser.set_defaults(hier_snapshot=True)
     parser.add_argument(
         "--distributed", metavar="HOST:PORT,NPROC,RANK",
         help="join a multi-host shardplane mesh: initialize "

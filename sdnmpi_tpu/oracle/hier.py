@@ -67,7 +67,7 @@ from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
-from sdnmpi_tpu.oracle.batch import bucket_len
+from sdnmpi_tpu.oracle.batch import bucket_len, bucket_pow2
 from sdnmpi_tpu.oracle.engine import RouteOracle, _timed_batch
 from sdnmpi_tpu.utils.metrics import REGISTRY
 from sdnmpi_tpu.utils.tracing import STATS
@@ -99,6 +99,38 @@ _m_full_builds = REGISTRY.counter(
 _m_rows = REGISTRY.counter(
     "hier_border_rows_total",
     "lazily materialized border-distance plane rows",
+)
+_m_row_hits = REGISTRY.counter(
+    "hier_border_cache_hits_total",
+    "destination pods served straight from the cached border-distance "
+    "row plane (no sweep)",
+)
+_m_row_misses = REGISTRY.counter(
+    "hier_border_cache_misses_total",
+    "destination pods whose border-distance rows had to be swept in "
+    "(cold or post-invalidation)",
+)
+_m_row_evictions = REGISTRY.counter(
+    "hier_border_cache_evictions_total",
+    "cached border-distance rows dropped by delta-log invalidation "
+    "(level-2 rebuilds evict the whole plane — rows are global "
+    "distances)",
+)
+_m_rows_cached = REGISTRY.gauge(
+    "hier_border_rows_cached",
+    "border-distance rows currently resident in the concatenated "
+    "serving plane",
+)
+_m_warm_s = REGISTRY.gauge(
+    "hier_warm_seconds",
+    "wall seconds of the last hier warm_serving pass (refresh + "
+    "serving-set rows + the pow2 program ladder)",
+)
+_m_snap_rejected = REGISTRY.counter(
+    "hier_snapshot_rejected_total",
+    "persisted border planes refused at restore (topology digest or "
+    "version mismatch) — the oracle degrades to a cold build, never "
+    "a crash",
 )
 _m_pod_imbalance = REGISTRY.gauge(
     "hier_pod_imbalance",
@@ -168,13 +200,32 @@ class HierState:
         #: [nB], cand [nB, K] int32 — pads point at the border itself,
         #: weights [nB, K] f32 — pads inf).
         self.deg_buckets: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        #: per-degree-bucket out-port tables parallel to ``deg_buckets``
+        #: (same [nB, K] layout, -1 = intra-pod candidate) plus the
+        #: border -> (bucket, row) index — the batched path builder's
+        #: (oracle/hierpath.py) descent tables
+        self.desc_ports: list[np.ndarray] = []
+        self.desc_bucket: Optional[np.ndarray] = None  # [B] int32
+        self.desc_pos: Optional[np.ndarray] = None  # [B] int64
         #: lazy border-distance plane: pod -> [b_pod, B] f32 rows, where
         #: row j is dist(every border -> pod border j). THE level-2
         #: serving tensor; O(active pods x B), never [B, B] unless
-        #: every pod is queried.
+        #: every pod is queried. Rows are VIEWS into the concatenated
+        #: ``plane_h`` buffer below.
         self.rows: dict[int, np.ndarray] = {}
         #: device twins of the row cache (sharded when a mesh exists)
         self.rows_d: dict[int, object] = {}
+        #: the concatenated border-row serving plane (ISSUE 18): every
+        #: materialized pod's rows stacked append-only into one
+        #: ``[cap, B]`` f32 buffer (pow2 cap growth -> the fused
+        #: composition kernel recompiles O(log B) times, never per
+        #: shape), with ``plane_base[pod]`` the pod's row offset (-1 =
+        #: absent) and a lazily uploaded device twin the composition
+        #: gathers from without per-route copies.
+        self.plane_h: Optional[np.ndarray] = None
+        self.plane_base: Optional[np.ndarray] = None  # [P] int64
+        self.plane_len: int = 0
+        self.plane_d: object = None
         #: the mesh (and ring flag) the device executors run on; set at
         #: build so lazy row materialization lands on the same devices
         self.mesh = None
@@ -195,8 +246,11 @@ class HierState:
         for a in (self.ccand, self.cw, self.cport):
             if a is not None:
                 total += a.nbytes
-        for r in self.rows.values():
-            total += r.nbytes
+        if self.plane_h is not None:
+            total += self.plane_h.nbytes
+        else:
+            for r in self.rows.values():
+                total += r.nbytes
         return total
 
     def device_bytes(self) -> int:
@@ -210,21 +264,67 @@ class HierState:
                     total += a.size * a.dtype.itemsize
         for r in self.rows_d.values():
             total += r.size * r.dtype.itemsize
+        if self.plane_d is not None:
+            total += self.plane_d.size * self.plane_d.dtype.itemsize
         return total
 
     # -- level 2: lazy border-distance rows --------------------------------
+
+    def _plane_append(self, p: int, block: np.ndarray) -> None:
+        """Append one pod's border-distance rows to the concatenated
+        serving plane (pow2 capacity growth; the device twin drops and
+        re-uploads lazily on the next fused composition)."""
+        bp = block.shape[0]
+        need = self.plane_len + bp
+        if self.plane_h is None or self.plane_h.shape[0] < need:
+            cap = 32
+            while cap < need:
+                cap *= 2
+            fresh = np.full((cap, self.n_borders), np.inf, np.float32)
+            if self.plane_len:
+                fresh[: self.plane_len] = self.plane_h[: self.plane_len]
+            self.plane_h = fresh
+            # the rows dict holds views into the old buffer: re-point
+            for q in list(self.rows):
+                b0 = int(self.plane_base[q])
+                if b0 >= 0:
+                    bq = int(
+                        self.pod_bstart[q + 1] - self.pod_bstart[q]
+                    )
+                    self.rows[q] = self.plane_h[b0:b0 + bq]
+        base = self.plane_len
+        self.plane_h[base:base + bp] = block
+        self.plane_base[p] = base
+        self.plane_len = need
+        self.rows[p] = self.plane_h[base:base + bp]
+        self.plane_d = None
+
+    def plane_device(self):
+        """The device-resident twin of the concatenated row plane —
+        uploaded once per materialization event (append invalidates),
+        NOT per route; the fused composition gathers from it with zero
+        per-call copies."""
+        if self.plane_d is None and self.plane_h is not None:
+            import jax.numpy as jnp
+
+            self.plane_d = jnp.asarray(self.plane_h)
+        return self.plane_d
 
     def ensure_rows(self, pods) -> None:
         """Materialize the border-distance plane rows for ``pods``
         (dist from EVERY border to each pod's borders) if missing —
         one batched pull-sweep for all missing pods together, on the
         mesh's devices when one exists."""
-        missing = sorted(
-            p for p in {int(q) for q in pods} - set(self.rows)
+        wanted = sorted(
+            p for p in {int(q) for q in pods}
             if self.pod_bstart[p + 1] > self.pod_bstart[p]
         )
+        missing = [p for p in wanted if p not in self.rows]
+        if len(wanted) > len(missing):
+            _m_row_hits.inc(len(wanted) - len(missing))
         if not missing:
             return
+        _m_row_misses.inc(len(missing))
         targets = np.concatenate([
             np.arange(self.pod_bstart[p], self.pod_bstart[p + 1])
             for p in missing
@@ -244,11 +344,12 @@ class HierState:
         off = 0
         for p in missing:
             bp = int(self.pod_bstart[p + 1] - self.pod_bstart[p])
-            self.rows[p] = rows[off:off + bp]
+            self._plane_append(p, rows[off:off + bp])
             if rows_d is not None:
                 self.rows_d[p] = rows_d[off:off + bp]
             off += bp
         _m_rows.inc(len(targets))
+        _m_rows_cached.set(self.plane_len)
 
 
 def sweep_rows_host(
@@ -323,7 +424,11 @@ def build_state(
     unchanged), untouched pod blocks carry over, and level 2 — the
     cheap layer — rebuilds unconditionally.
     """
-    from sdnmpi_tpu.shardplane.hier import pod_stack_apsp, shard_pod_stack
+    from sdnmpi_tpu.shardplane.hier import (
+        pod_stack_apsp,
+        pod_stack_apsp_async,
+        shard_pod_stack,
+    )
 
     state = HierState()
     state.podmap = podmap
@@ -406,6 +511,12 @@ def build_state(
                 b.port[sl_e[m], ls[m], ld[m]] = pe[m]
 
     # -- level 1: per-bucket stacked APSP (dense kernels, vmapped) -------
+    # ISSUE 18 overlap: every bucket's APSP dispatches asynchronously
+    # first; the level-2 border/structure derivation (which needs only
+    # adjacency + membership) runs while the devices grind; the host
+    # mirrors materialize after, and the distance-dependent level-2
+    # finish consumes them. Same numbers, less serialized wall.
+    pend: list[tuple[_Bucket, object, object, int, bool]] = []
     for b in state.buckets:
         carried = False
         if prev is not None and only_pods is not None:
@@ -443,13 +554,29 @@ def build_state(
                     b.dist_d, b.nxt_d = pb.dist_d, pb.nxt_d
                 carried = True
         if not carried:
-            b.dist, b.nxt = pod_stack_apsp(b.adj, mesh=mesh)
-            if mesh is not None:
+            dd, nd, nn, sharded = pod_stack_apsp_async(b.adj, mesh)
+            pend.append((b, dd, nd, nn, sharded))
+
+    # -- level 2 structure: overlaps the in-flight APSP dispatches -------
+    pre = _derive_borders(state, src_g, dst_g, intra)
+
+    for b, dd, nd, nn, sharded in pend:
+        b.dist = np.asarray(dd)[:nn]
+        b.nxt = np.asarray(nd)[:nn]
+        if mesh is not None:
+            if sharded:
+                # the padded device output already carries the
+                # shard_pod_stack layout — keep it as the resident twin
+                # (pad-slot content differs from zero-fill, but no
+                # consumer reads pad rows: the ring exchange gathers
+                # only the nP real rows)
+                b.dist_d, b.nxt_d = dd, nd
+            else:
                 b.dist_d = shard_pod_stack(b.dist, mesh)
                 b.nxt_d = shard_pod_stack(b.nxt, mesh)
 
-    # -- level 2: borders + skeleton --------------------------------------
-    _build_level2(state, src_g, dst_g, port_g, intra)
+    # -- level 2 finish: the distance-dependent skeleton weights ---------
+    _finish_level2(state, src_g, dst_g, port_g, intra, pre)
     _m_pods.set(state.n_pods)
     _m_borders.set(state.n_borders)
     real_cells = int((sizes * sizes).sum())
@@ -461,15 +588,13 @@ def build_state(
     return state
 
 
-def _build_level2(
-    state: HierState, src_g, dst_g, port_g, intra
-) -> None:
-    """Derive borders and the skeleton candidate CSR (the level-2
-    structure). Cheap relative to the pod blocks: O(E_inter + the sum
-    of border-set squares). Under ``state.ring`` the intra-pod
-    border-distance blocks arrive over the PR-10 ring exchange from
-    the pod-sharded device stacks instead of a host gather
-    (bit-identity fenced in tests/test_hier.py)."""
+def _derive_borders(state: HierState, src_g, dst_g, intra):
+    """The distance-independent half of level 2: derive the border
+    arrays and numbering from adjacency + membership alone (vectorized
+    — at 65k switches the old per-border Python loop was a measurable
+    slice of refresh). Split out so ``build_state`` can run it while
+    the pod-block APSP dispatches are still in flight on the devices.
+    Returns the inter-edge index array ``_finish_level2`` consumes."""
     v = state.v
     inter_idx = (
         np.nonzero(~intra)[0] if len(intra) else np.zeros(0, np.int64)
@@ -480,25 +605,46 @@ def _build_level2(
         border_mask[dst_g[inter_idx]] = True
 
     border_id_of_g = np.full(max(v, 1), -1, np.int32)
+    gb = np.nonzero(border_mask[:v])[0] if v else np.zeros(0, np.int64)
+    pods_b = (
+        state.pod_of_g[gb] if len(gb) else np.zeros(0, np.int32)
+    )
+    # pod-major, members ascending within each pod — gb is ascending
+    # and the stable sort preserves it, matching the old loop's order
+    order = np.argsort(pods_b, kind="stable")
+    gb, pods_b = gb[order], pods_b[order]
+    bid = len(gb)
+    border_id_of_g[gb] = np.arange(bid, dtype=np.int32)
     pod_bstart = np.zeros(state.n_pods + 1, np.int64)
-    b_gidx, b_pod, b_local = [], [], []
-    bid = 0
-    for p in range(state.n_pods):
-        pod_bstart[p] = bid
-        m = state.pods_members[p]
-        for g in (m[border_mask[m]] if len(m) else m):
-            border_id_of_g[g] = bid
-            b_gidx.append(int(g))
-            b_pod.append(p)
-            b_local.append(int(state.local_of_g[g]))
-            bid += 1
-    pod_bstart[state.n_pods] = bid
+    np.cumsum(
+        np.bincount(pods_b, minlength=state.n_pods), out=pod_bstart[1:]
+    )
     state.n_borders = bid
-    state.border_gidx = np.array(b_gidx, np.int32)
-    state.border_pod = np.array(b_pod, np.int32)
-    state.border_local = np.array(b_local, np.int32)
+    state.border_gidx = gb.astype(np.int32)
+    state.border_pod = pods_b.astype(np.int32)
+    state.border_local = (
+        state.local_of_g[gb].astype(np.int32)
+        if len(gb) else np.zeros(0, np.int32)
+    )
     state.pod_bstart = pod_bstart
     state.border_id_of_g = border_id_of_g
+    return inter_idx
+
+
+def _finish_level2(
+    state: HierState, src_g, dst_g, port_g, intra, inter_idx
+) -> None:
+    """The distance-dependent half of level 2: skeleton candidate CSR
+    (intra edges weighted by the pod blocks' border-to-border
+    distances, inter edges weight 1), degree-bucketed candidate
+    tables, and the row-cache reset. Cheap relative to the pod blocks:
+    O(E_inter + the sum of border-set squares). Under ``state.ring``
+    the intra-pod border-distance blocks arrive over the PR-10 ring
+    exchange from the pod-sharded device stacks instead of a host
+    gather (bit-identity fenced in tests/test_hier.py)."""
+    pod_bstart = state.pod_bstart
+    border_id_of_g = state.border_id_of_g
+    bid = state.n_borders
 
     # intra border->border distance blocks: over the ring when armed,
     # a host slice of the pod blocks otherwise — bit-identical
@@ -561,22 +707,52 @@ def _build_level2(
     state.cstart, state.ccand, state.cw, state.cport = (
         cstart, ccand, cw, cport,
     )
-    state.deg_buckets = _degree_buckets(cstart, ccand, cw, state.n_borders)
+    (
+        state.deg_buckets, state.desc_ports,
+        state.desc_bucket, state.desc_pos,
+    ) = _degree_buckets(cstart, ccand, cw, cport, state.n_borders)
     state.rows = {}
     state.rows_d = {}
+    state.plane_h = None
+    state.plane_base = np.full(max(state.n_pods, 1), -1, np.int64)
+    state.plane_len = 0
+    state.plane_d = None
+    _m_rows_cached.set(0)
     _m_l2_refreshes.inc()
 
 
-def _degree_buckets(cstart, ccand, cw, n_borders: int):
+def _build_level2(
+    state: HierState, src_g, dst_g, port_g, intra
+) -> None:
+    """Borders + skeleton in one pass (the non-overlapped form — see
+    ``build_state`` for the split that hides the structure derivation
+    behind the in-flight APSP dispatches)."""
+    inter_idx = _derive_borders(state, src_g, dst_g, intra)
+    _finish_level2(state, src_g, dst_g, port_g, intra, inter_idx)
+
+
+def _degree_buckets(cstart, ccand, cw, cport, n_borders: int):
     """Uniform candidate tables per out-degree bucket (pow2, floor 8):
     the sweep executors gather ``[rows, nB, K]`` and reduce with one
     reshape-min per bucket — ~10x the segmented reduce at datacenter
     scale, at <= 2x the gathered bytes. Pad slots point at the border
-    itself with inf weight (self-relaxation is a no-op)."""
+    itself with inf weight (self-relaxation is a no-op). Table rows
+    preserve CSR (candidate-ascending) order, reals before pads, so an
+    argmin over a row picks the same first-minimum winner as a scalar
+    argmin over the CSR slice — the batched descent (hierpath) relies
+    on it.
+
+    Returns ``(buckets, port_tables, border_bucket, border_pos)``:
+    ``port_tables[i]`` mirrors ``buckets[i]``'s [nB, K] layout with the
+    out-ports (-1 = intra-pod edge, pads -1), and border u lives at row
+    ``border_pos[u]`` of bucket ``border_bucket[u]``."""
     counts = np.diff(cstart)
     buckets: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    ports: list[np.ndarray] = []
+    border_bucket = np.full(max(n_borders, 1), -1, np.int32)
+    border_pos = np.zeros(max(n_borders, 1), np.int64)
     if not n_borders or not len(ccand):
-        return buckets
+        return buckets, ports, border_bucket, border_pos
     k_of = np.maximum(counts, 1)
     k_of = 2 ** np.ceil(np.log2(np.maximum(k_of, 8))).astype(np.int64)
     for k in np.unique(k_of):
@@ -584,12 +760,23 @@ def _degree_buckets(cstart, ccand, cw, n_borders: int):
         nb = len(ids)
         cand = np.repeat(ids.astype(np.int32)[:, None], k, axis=1)
         w = np.full((nb, int(k)), np.inf, np.float32)
-        for row, u in enumerate(ids):
-            lo, hi = int(cstart[u]), int(cstart[u + 1])
-            cand[row, : hi - lo] = ccand[lo:hi]
-            w[row, : hi - lo] = cw[lo:hi]
+        prt = np.full((nb, int(k)), -1, np.int32)
+        cnt = counts[ids]
+        if cnt.sum():
+            rowrep = np.repeat(np.arange(nb), cnt)
+            colidx = np.arange(int(cnt.sum())) - np.repeat(
+                np.cumsum(cnt) - cnt, cnt
+            )
+            srcpos = colidx + np.repeat(cstart[ids], cnt)
+            cand[rowrep, colidx] = ccand[srcpos]
+            w[rowrep, colidx] = cw[srcpos]
+            prt[rowrep, colidx] = cport[srcpos]
+        bi = len(buckets)
+        border_bucket[ids] = bi
+        border_pos[ids] = np.arange(nb)
         buckets.append((ids, cand, w))
-    return buckets
+        ports.append(prt)
+    return buckets, ports, border_bucket, border_pos
 
 
 # -- query composition ----------------------------------------------------
@@ -598,12 +785,20 @@ def _degree_buckets(cstart, ccand, cw, n_borders: int):
 class _Composer:
     """Vectorized hierarchy composition for one resolved query batch."""
 
-    def __init__(self, state: HierState, steer: Optional[np.ndarray]):
+    def __init__(
+        self, state: HierState, steer: Optional[np.ndarray],
+        fused: bool = False,
+    ):
         self.st = state
         #: per-switch utilization score (the pod-aggregated view of
         #: the Monitor samples); breaks ties among equal-length border
         #: choices ONLY — lengths are steering-invariant
         self.steer = steer
+        #: route the composition through the fused device kernel
+        #: (kernels/hiercompose.py) over the concatenated row plane
+        #: instead of the per-pod host gather chain — bit-identical
+        #: (fenced), O(log) trace space, warm-ladder precompiled
+        self.fused = bool(fused)
 
     # -- vectorized block reads -------------------------------------------
 
@@ -688,6 +883,10 @@ class _Composer:
 
         colsA = np.arange(bA)
         colsB = np.arange(bB)
+        fused = (
+            self.fused and st.plane_h is not None and st.n_borders > 0
+        )
+        plane_dev = st.plane_device() if fused else None
         chunk = max(1, (1 << 22) // max(1, bA * bB))
         for lo in range(0, n, chunk):
             sl_ = slice(lo, min(n, lo + chunk))
@@ -697,6 +896,13 @@ class _Composer:
                 st.pod_bstart[ps][:, None] + colsA[None, :],
                 st.pod_bstart[ps + 1][:, None] - 1,
             )  # [m, bA] border ids of src pods (clamped pads)
+            if fused:
+                self._compose_chunk_fused(
+                    plane_dev, sl_, lo, ps, pd, gidA,
+                    dsb[sl_], dbd[sl_], cntA[sl_], cntB[sl_],
+                    colsA, colsB, total, b1, b2, pod_s, pod_d,
+                )
+                continue
             cross = np.full((m, bA, bB), np.inf, np.float32)
             for p in np.unique(pd):
                 rows_p = st.rows.get(int(p))
@@ -755,6 +961,75 @@ class _Composer:
             b1[gl] = st.pod_bstart[pod_s[gl]] + pick // bB
             b2[gl] = st.pod_bstart[pod_d[gl]] + pick % bB
         return total, b1, b2
+
+    def _compose_chunk_fused(
+        self, plane_dev, sl_, lo, ps, pd, gidA, dsb_c, dbd_c,
+        cntA_c, cntB_c, colsA, colsB, total, b1, b2, pod_s, pod_d,
+    ) -> None:
+        """One chunk through the fused device kernel. Operands pad to
+        pow2 buckets (rows, src borders, dest borders) so the whole
+        serving trace space is the O(log) ladder ``warm_serving``
+        precompiles; pads carry inf distances (masked exactly like the
+        host path's validA/validB) and index 0 (harmless gathers). The
+        tie-break decode runs against the PADDED bB — argmax over the
+        padded row-major flat picks the same lexicographic-first
+        (b1, b2) as the host path because within-row column order and
+        row order are both preserved."""
+        st = self.st
+        m, bA = gidA.shape
+        bB = len(colsB)
+        mp = bucket_pow2(m, 8)
+        bAp = bucket_pow2(bA, 8)
+        bBp = bucket_pow2(bB, 8)
+        validA = colsA[None, :] < cntA_c[:, None]
+        validB = colsB[None, :] < cntB_c[:, None]
+        dsbm = np.full((mp, bAp), np.inf, np.float32)
+        dsbm[:m, :bA] = np.where(validA, dsb_c, np.inf)
+        dbdm = np.full((mp, bBp), np.inf, np.float32)
+        dbdm[:m, :bB] = np.where(validB, dbd_c, np.inf)
+        gA = np.zeros((mp, bAp), np.int32)
+        gA[:m, :bA] = gidA
+        ridx = np.zeros((mp, bBp), np.int32)
+        base = st.plane_base[pd].astype(np.int64)
+        # absent-plane pods (base -1: borderless dest, masked inf by
+        # dbdm) clamp into the buffer like every other pad
+        ridx[:m, :bB] = np.clip(
+            base[:, None] + colsB[None, :],
+            0, st.plane_h.shape[0] - 1,
+        ).astype(np.int32)
+        if self.steer is not None:
+            lA = np.full((mp, bAp), np.inf, np.float32)
+            lA[:m, :bA] = np.where(
+                validA, self.steer[st.border_gidx[gidA]], np.inf
+            )
+            gidB = np.minimum(
+                st.pod_bstart[pd][:, None] + colsB[None, :],
+                st.pod_bstart[pd + 1][:, None] - 1,
+            )
+            lB = np.full((mp, bBp), np.inf, np.float32)
+            lB[:m, :bB] = np.where(
+                validB, self.steer[st.border_gidx[gidB]], np.inf
+            )
+        else:
+            # zero load planes collapse the steered pick to
+            # argmax(is_best) exactly — one kernel serves both modes
+            lA = np.zeros((mp, bAp), np.float32)
+            lB = np.zeros((mp, bBp), np.float32)
+        from sdnmpi_tpu.kernels.hiercompose import compose_fused
+
+        best_f, pick_f = compose_fused(
+            plane_dev, ridx, gA, dsbm, dbdm, lA, lB
+        )
+        best = best_f[:m]
+        use = best < total[sl_]  # strict: intra wins length ties
+        if not use.any():
+            return
+        rsel = np.nonzero(use)[0]
+        gl = rsel + lo
+        total[gl] = best[rsel]
+        pk = pick_f[:m][rsel].astype(np.int64)
+        b1[gl] = st.pod_bstart[pod_s[gl]] + pk // bBp
+        b2[gl] = st.pod_bstart[pod_d[gl]] + pk % bBp
 
     # -- path materialization ---------------------------------------------
 
@@ -860,6 +1135,45 @@ def window_congestion(hop_dpid: np.ndarray) -> float:
     return float(counts.max())
 
 
+def _pack_rows(r: np.ndarray) -> dict:
+    """Wire form of one pod's border-distance rows: base64 uint16 when
+    every finite value is an integral hop count < 65535 (exact f32
+    round-trip; 65535 encodes inf), raw f32 bytes otherwise."""
+    import base64
+
+    finite = np.isfinite(r)
+    vals = r[finite]
+    if vals.size == 0 or (
+        (vals < 65535).all() and (vals == np.floor(vals)).all()
+    ):
+        u = np.where(finite, r, 65535.0).astype(np.uint16)
+        return {
+            "enc": "u16", "shape": [int(s) for s in r.shape],
+            "data": base64.b64encode(u.tobytes()).decode("ascii"),
+        }
+    return {
+        "enc": "f32", "shape": [int(s) for s in r.shape],
+        "data": base64.b64encode(
+            np.ascontiguousarray(r, np.float32).tobytes()
+        ).decode("ascii"),
+    }
+
+
+def _unpack_rows(d: dict) -> np.ndarray:
+    import base64
+
+    raw = base64.b64decode(d["data"])
+    shape = tuple(int(s) for s in d["shape"])
+    if d["enc"] == "u16":
+        u = np.frombuffer(raw, np.uint16).reshape(shape)
+        out = u.astype(np.float32)
+        out[u == 65535] = np.inf
+        return out
+    if d["enc"] != "f32":
+        raise ValueError(f"unknown border-row encoding {d['enc']!r}")
+    return np.frombuffer(raw, np.float32).reshape(shape).copy()
+
+
 # -- the oracle -----------------------------------------------------------
 
 
@@ -890,6 +1204,8 @@ class HierOracle(RouteOracle):
         shard_oracle: bool = False,
         ring_exchange: bool = False,
         pod_target: int = 0,
+        fused: bool = True,
+        hier_warm: bool = True,
     ) -> None:
         hier_ring = bool(ring_exchange and mesh_devices)
         super().__init__(
@@ -904,6 +1220,13 @@ class HierOracle(RouteOracle):
             )
         self.pod_target = int(pod_target)
         self.hier_ring = hier_ring and self.mesh_devices > 0
+        #: serve through the fused composition kernel + batched path
+        #: builder (ISSUE 18). Default ON — the scalar chain is the
+        #: bit-identical escape hatch (``Config.hier_fused``).
+        self.fused = bool(fused)
+        #: precompile the pow2 program ladder in warm_serving
+        #: (``Config.hier_warm``); off = the pre-ladder warm behavior
+        self.hier_warm = bool(hier_warm)
         self._hier: Optional[HierState] = None
 
     # -- refresh / repair --------------------------------------------------
@@ -986,6 +1309,14 @@ class HierOracle(RouteOracle):
                     )
                 _m_full_builds.inc()
                 self.full_refresh_count += 1
+            if (
+                state is not self._hier
+                and self._hier is not None
+                and self._hier.plane_len
+            ):
+                # the delta log invalidated level 2: every cached
+                # border row of the outgoing state is gone
+                _m_row_evictions.inc(self._hier.plane_len)
             self._hier = state
             self._endpoint_memo = {}
             self._version = db.version
@@ -1028,10 +1359,28 @@ class HierOracle(RouteOracle):
         from sdnmpi_tpu.oracle.batch import WindowRoutes
 
         if rows:
-            comp = _Composer(state, steer)
+            comp = _Composer(state, steer, fused=self.fused)
             si = np.array([r[1] for r in rows], np.int64)
             di = np.array([r[2] for r in rows], np.int64)
             total, b1, b2 = comp.compose(si, di)
+            if comp.fused:
+                # batched path materialization (oracle/hierpath.py) —
+                # bit-identical to the scalar walk below (fenced)
+                from sdnmpi_tpu.oracle.hierpath import build_hop_arrays
+
+                fports = np.array([r[3] for r in rows], np.int32)
+                hd, hp, hl = build_hop_arrays(
+                    state, si, di, fports, total, b1, b2
+                )
+                ks = np.array([r[0] for r in rows], np.int64)
+                length = hd.shape[1]
+                hop_dpid = np.full((n_pairs, length), -1, np.int64)
+                hop_port = np.full((n_pairs, length), -1, np.int32)
+                hop_len = np.zeros(n_pairs, np.int32)
+                hop_dpid[ks] = hd
+                hop_port[ks] = hp
+                hop_len[ks] = hl
+                return WindowRoutes(hop_dpid, hop_port, hop_len)
             for x, (k, _si, _di, fport) in enumerate(rows):
                 results[k] = comp.fdb(
                     int(si[x]), int(di[x]), fport,
@@ -1125,31 +1474,56 @@ class HierOracle(RouteOracle):
             else self._steer_from(link_util, state)
         )
         fdbs: list[list[tuple[int, int]]] = [[] for _ in range(f)]
+        hop_arrays = None
         if ok.any():
-            comp = _Composer(state, steer)
+            comp = _Composer(state, steer, fused=self.fused)
             oki = np.nonzero(ok)[0]
             total, b1, b2 = comp.compose(
                 si[oki].astype(np.int64), di[oki].astype(np.int64)
             )
-            for x, k in enumerate(oki):
-                fdbs[k] = comp.fdb(
-                    int(si[k]), int(di[k]), int(final_port[k]),
-                    total[x], int(b1[x]), int(b2[x]),
+            if comp.fused:
+                from sdnmpi_tpu.oracle.hierpath import build_hop_arrays
+
+                hop_arrays = (oki,) + build_hop_arrays(
+                    state, si[oki].astype(np.int64),
+                    di[oki].astype(np.int64),
+                    final_port[oki], total, b1, b2,
                 )
-        max_l = max((len(fdb) for fdb in fdbs), default=1) or 1
-        hop_dpid = np.full((f, max_l), -1, np.int64)
-        hop_port = np.full((f, max_l), -1, np.int32)
-        hop_len = np.zeros(f, np.int32)
+            else:
+                for x, k in enumerate(oki):
+                    fdbs[k] = comp.fdb(
+                        int(si[k]), int(di[k]), int(final_port[k]),
+                        total[x], int(b1[x]), int(b2[x]),
+                    )
         pair_sub = np.arange(f, dtype=np.int32)
         pair_sub[~ok] = -1
-        for k, fdb in enumerate(fdbs):
-            if not fdb:
-                continue
-            hop_len[k] = len(fdb)
-            for h, (dpid, port) in enumerate(fdb):
-                hop_dpid[k, h] = dpid
-                hop_port[k, h] = port
-            hop_port[k, len(fdb) - 1] = -1  # per-pair placeholder
+        if hop_arrays is not None:
+            oki, hd, hp, hl = hop_arrays
+            max_l = hd.shape[1]
+            hop_dpid = np.full((f, max_l), -1, np.int64)
+            hop_port = np.full((f, max_l), -1, np.int32)
+            hop_len = np.zeros(f, np.int32)
+            hop_dpid[oki] = hd
+            hop_port[oki] = hp
+            hop_len[oki] = hl
+            routed = oki[hl > 0]
+            # the final switch's out-port is per PAIR (final_port);
+            # the sub-flow slot keeps the placeholder, like the
+            # scalar assembly below
+            hop_port[routed, hop_len[routed] - 1] = -1
+        else:
+            max_l = max((len(fdb) for fdb in fdbs), default=1) or 1
+            hop_dpid = np.full((f, max_l), -1, np.int64)
+            hop_port = np.full((f, max_l), -1, np.int32)
+            hop_len = np.zeros(f, np.int32)
+            for k, fdb in enumerate(fdbs):
+                if not fdb:
+                    continue
+                hop_len[k] = len(fdb)
+                for h, (dpid, port) in enumerate(fdb):
+                    hop_dpid[k, h] = dpid
+                    hop_port[k, h] = port
+                hop_port[k, len(fdb) - 1] = -1  # per-pair placeholder
         maxc = window_congestion(hop_dpid)
         self._note_congestion(
             maxc, dag=False, phase=_phase or _phase_scan is not None
@@ -1221,7 +1595,7 @@ class HierOracle(RouteOracle):
         di = state.index.get(dst_dpid)
         if si is None or di is None:
             return []
-        comp = _Composer(state, None)
+        comp = _Composer(state, None, fused=self.fused)
         total, b1, b2 = comp.compose(
             np.array([si], np.int64), np.array([di], np.int64)
         )
@@ -1242,6 +1616,12 @@ class HierOracle(RouteOracle):
         return _py_all_shortest_routes(db, src_dpid, dst_dpid, max_paths)
 
     def warm_serving(self, db: "TopologyDB", shapes=(8, 256)) -> dict:
+        """Warm the hier serving path: refresh (compiling the pod-stack
+        APSP buckets), materialize the serving set's border rows, and —
+        under ``hier_warm`` — precompile the full pow2 program ladder
+        (row-sweep rungs + composition buckets) so steady serving never
+        traces (ISSUE 18; count_trace-probed in tests). The batched
+        path builder is host numpy — nothing of it compiles."""
         import time as _time
 
         t0 = _time.perf_counter()
@@ -1255,16 +1635,135 @@ class HierOracle(RouteOracle):
             for h in db.hosts.values() if h.port.dpid in state.index
         }
         state.ensure_rows(pods)
+        compiled = 0
+        if self.hier_warm:
+            compiled = self._warm_ladder(state, shapes)
         max_len = 0
         for r in state.rows.values():
             finite = r[np.isfinite(r)]
             if finite.size:
                 max_len = max(max_len, int(finite.max()))
-        return {
+        out = {
             "warm_s": _time.perf_counter() - t0,
             "shapes": sorted({int(s) for s in shapes if s > 0}),
             "max_len": max_len,
+            "compiled": compiled,
         }
+        _m_warm_s.set(out["warm_s"])
+        return out
+
+    def _warm_ladder(self, state: HierState, shapes) -> int:
+        """Walk the pow2 bucket ladder the serving path dispatches
+        through: one row-sweep rung per pow2 quanta count up to the
+        materialized plane, and one fused-composition program per
+        (m bucket) x (src border bucket) x (dest border bucket) combo
+        that can actually occur — bA/bB are always SOME pod's true
+        border count (a chunk max), so only buckets present in
+        ``pod_bstart``'s count set can appear. Returns the program
+        count warmed (compile or compile-cache hit each)."""
+        compiled = 0
+        if state.n_borders == 0:
+            return compiled
+        if (
+            state.mesh is not None and state.deg_buckets
+            and state.plane_len
+        ):
+            from sdnmpi_tpu.shardplane.hier import warm_sweep_ladder
+
+            compiled += len(warm_sweep_ladder(
+                state.deg_buckets, state.n_borders, state.mesh,
+                state.plane_len,
+            ))
+        if not self.fused or state.plane_h is None:
+            return compiled
+        from sdnmpi_tpu.kernels.hiercompose import warm_compose
+
+        plane = state.plane_device()
+        counts = np.diff(state.pod_bstart)
+        present = sorted({
+            bucket_pow2(int(c), 8) for c in counts if c > 0
+        })
+        for a in present:
+            for b in present:
+                # compose chunks at (1 << 22) // (bA * bB) pairs, so a
+                # window's TAIL chunk can bucket to any pow2 from 8 up
+                # to bucket_pow2(chunk) — warm the whole rung ladder
+                # (O(log) programs per bucket pair), nothing else can
+                # be dispatched
+                c0 = bucket_pow2(max(1, (1 << 22) // (a * b)), 8)
+                m = 8
+                while True:
+                    warm_compose(plane, m, a, b)
+                    compiled += 1
+                    if m >= c0:
+                        break
+                    m *= 2
+        return compiled
+
+    # -- the persistent border plane (ISSUE 18) ----------------------------
+
+    def border_snapshot(self, db: "TopologyDB") -> Optional[dict]:
+        """Serializable snapshot of the materialized border-distance
+        row plane, topology-digest guarded like the route-cache memo.
+        None when there is nothing to persist (no state, stale state,
+        or no materialized rows)."""
+        from sdnmpi_tpu.oracle.routecache import RouteCache
+
+        state = self._hier
+        if (
+            state is None or self._version != db.version
+            or not state.plane_len
+        ):
+            return None
+        return {
+            "version": 1,
+            "digest": RouteCache.topology_digest(db),
+            "n_borders": int(state.n_borders),
+            "pods": {
+                str(p): _pack_rows(r)
+                for p, r in sorted(state.rows.items())
+            },
+        }
+
+    def restore_border_rows(self, snap, db: "TopologyDB") -> int:
+        """Seed the border-row plane from :meth:`border_snapshot`.
+        The state rebuilds cold first (``refresh``), so a digest or
+        shape mismatch just leaves the lazy path in charge — counted
+        ``hier_snapshot_rejected_total``, never a crash. Restored rows
+        are bit-identical to a cold sweep (the u16 wire is exact for
+        hop counts), so every downstream fence holds. Returns the
+        restored row count."""
+        from sdnmpi_tpu.oracle.routecache import RouteCache
+
+        if not isinstance(snap, dict) or snap.get("version") != 1:
+            if snap is not None:
+                _m_snap_rejected.inc()
+            return 0
+        state = self.refresh(db)
+        if (
+            snap.get("digest") != RouteCache.topology_digest(db)
+            or int(snap.get("n_borders", -1)) != state.n_borders
+        ):
+            _m_snap_rejected.inc()
+            return 0
+        restored = 0
+        for key, packed in snap.get("pods", {}).items():
+            try:
+                p = int(key)
+                rows = _unpack_rows(packed)
+            except (ValueError, KeyError, TypeError):
+                _m_snap_rejected.inc()
+                return restored
+            if p < 0 or p >= state.n_pods or p in state.rows:
+                continue
+            bp = int(state.pod_bstart[p + 1] - state.pod_bstart[p])
+            if rows.shape != (bp, state.n_borders):
+                _m_snap_rejected.inc()
+                continue
+            state._plane_append(p, rows)
+            restored += bp
+        _m_rows_cached.set(state.plane_len)
+        return restored
 
     def matrices(self, db: "TopologyDB"):
         raise NotImplementedError(

@@ -139,16 +139,25 @@ def _stack_apsp_sharded_fn(mesh, cb: int):
     return jax.jit(fn)
 
 
-def pod_stack_apsp(adj, mesh=None):
-    """(dist [nP, s, s] f32, next [nP, s, s] int32) for a stacked pod
-    bucket, as host arrays. With a mesh and enough pods the stack
-    partitions over every device (pods converge independently —
-    shard_map with no collectives); otherwise one vmapped program."""
+def pod_stack_apsp_async(adj, mesh=None):
+    """Dispatch the stacked-bucket APSP WITHOUT materializing the host
+    arrays: returns ``(dist_dev, nxt_dev, n, sharded)`` where the
+    device arrays are padded to the shard quantum and ``n`` is the real
+    pod count. ``np.asarray(...)[:n]`` later forces the sync — the
+    ISSUE 18 refresh overlap dispatches every bucket first, derives the
+    level-2 border/skeleton structure (which needs only adjacency and
+    membership) while the devices grind, then collects. ``sharded``
+    tells the caller the padded device output already carries the
+    ``shard_pod_stack`` layout and can be kept as the resident twin
+    with no re-upload."""
     adj = np.ascontiguousarray(adj, np.float32)
     n, s, _ = adj.shape
     if n == 0:
         return (
-            np.zeros((0, s, s), np.float32), np.zeros((0, s, s), np.int32)
+            np.zeros((0, s, s), np.float32),
+            np.zeros((0, s, s), np.int32),
+            0,
+            False,
         )
     if mesh is not None:
         shards = mesh_shards(mesh)
@@ -160,10 +169,19 @@ def pod_stack_apsp(adj, mesh=None):
                 )
             cb = _col_chunk(adj.shape[0] // shards, s)
             dist, nxt = _stack_apsp_sharded_fn(mesh, cb)(adj)
-            return np.asarray(dist)[:n], np.asarray(nxt)[:n]
+            return dist, nxt, n, True
     cb = _col_chunk(n, s)
     dist, nxt = _stack_apsp_jit(jnp.asarray(adj), cb)
-    return np.asarray(dist), np.asarray(nxt)
+    return dist, nxt, n, False
+
+
+def pod_stack_apsp(adj, mesh=None):
+    """(dist [nP, s, s] f32, next [nP, s, s] int32) for a stacked pod
+    bucket, as host arrays. With a mesh and enough pods the stack
+    partitions over every device (pods converge independently —
+    shard_map with no collectives); otherwise one vmapped program."""
+    dist, nxt, n, _ = pod_stack_apsp_async(adj, mesh)
+    return np.asarray(dist)[:n], np.asarray(nxt)[:n]
 
 
 def shard_pod_stack(arr: np.ndarray, mesh):
@@ -258,13 +276,23 @@ def sweep_rows_sharded(deg_buckets, n_borders, targets, mesh):
     Per-chunk convergence note: the host executor iterates each row
     chunk to ITS fixpoint independently, and rows are independent, so
     chunk-local while_loops (here per device, per chunk) land on the
-    identical fixpoint."""
+    identical fixpoint.
+
+    The row count pads to a POW2 number of quanta (ISSUE 18 warm
+    ladder), not just the next quantum: the trace space collapses to
+    O(log pods) distinct programs, all precompiled by
+    ``warm_sweep_ladder``. Pad rows are -1 targets — all-inf rows that
+    converge in one sweep and touch no real row, so the extra padding
+    costs epsilon compute and zero exactness."""
     t = len(targets)
     if t == 0 or n_borders == 0:
         return np.zeros((t, n_borders), np.float32), None
     shards = mesh_shards(mesh)
     quantum = max(1, shards) * _SWEEP_ROW_CHUNK
-    pad = (-t) % quantum
+    nq = 1
+    while nq * quantum < t:
+        nq *= 2
+    pad = nq * quantum - t
     tloc = np.concatenate(
         [np.asarray(targets, np.int32), np.full(pad, -1, np.int32)]
     )
@@ -284,6 +312,47 @@ def sweep_rows_sharded(deg_buckets, n_borders, targets, mesh):
         fn = _sweep_jit_fn(shapes, int(n_borders), _SWEEP_ROW_CHUNK)
     rows_d = fn(tloc, *flat)
     return np.asarray(rows_d)[:t], rows_d
+
+
+def warm_sweep_ladder(deg_buckets, n_borders, mesh, max_rows) -> list[int]:
+    """Precompile the row-sweep program ladder: one dispatch per pow2
+    quanta count up to the bucket covering ``max_rows``, with all-pad
+    (-1) target blocks. Pad rows start all-inf, so each rung's
+    while_loop exits after a single sweep — the compile (or the
+    persistent compile-cache load) is the entire cost. The jitted
+    callables are the SAME lru-cached functions ``sweep_rows_sharded``
+    dispatches through, so every later real sweep at a warmed shape is
+    a trace-cache hit (count_trace-probed in tests). Returns the warmed
+    row counts."""
+    if n_borders == 0 or max_rows <= 0 or not deg_buckets:
+        return []
+    shards = mesh_shards(mesh) if mesh is not None else 1
+    quantum = max(1, shards) * _SWEEP_ROW_CHUNK
+    flat = []
+    shapes = []
+    for ids, cand, w in deg_buckets:
+        flat.extend(
+            (jnp.asarray(ids), jnp.asarray(cand), jnp.asarray(w))
+        )
+        shapes.append(cand.shape)
+    shapes = tuple(shapes)
+    if shards > 1:
+        fn = _sweep_sharded_fn(
+            mesh, shapes, int(n_borders), _SWEEP_ROW_CHUNK
+        )
+    else:
+        fn = _sweep_jit_fn(shapes, int(n_borders), _SWEEP_ROW_CHUNK)
+    warmed = []
+    nq = 1
+    while True:
+        rows = nq * quantum
+        tloc = np.full(rows, -1, np.int32)
+        np.asarray(fn(tloc, *flat))
+        warmed.append(rows)
+        if rows >= max_rows:
+            break
+        nq *= 2
+    return warmed
 
 
 # -- the ring-exchanged border-distance plane -----------------------------

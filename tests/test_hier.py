@@ -430,6 +430,22 @@ class TestConfig15Machinery:
 
         assert any(name == "15" for name, _ in CONFIGS)
 
+    def test_serving_twin_machinery(self):
+        """The cold-vs-warm twin legs at test scale: the in-config
+        bit-identity fence runs BEFORE numbers, the warm ladder
+        compiles programs, and the snapshot leg restores rows."""
+        from benchmarks.config15_hier import measure_serving_twin
+
+        s = measure_serving_twin(
+            k=8, pods=12, hosts_per_edge=1, n_ranks=8,
+            mesh_devices=0, iters=1,
+        )
+        assert s["fence"].startswith("warm==scalar==restored")
+        assert s["compiled"] > 0
+        assert s["restored_rows"] > 0
+        assert s["warm_first_ms"] > 0 and s["warm_steady_ms"] > 0
+        assert s["scalar_steady_ms"] > 0 and s["warm_refresh_ms"] > 0
+
     def test_committed_rows_gate(self):
         """The committed config-15 rows: schema-complete, the memory
         headroom >= the acceptance bound (peak per-device < 1/8 of the
@@ -464,6 +480,34 @@ class TestConfig15Machinery:
         )
         twin = rows["15b"]
         assert twin["vs_baseline"] >= 1.0 / REFRESH_RATIO_MAX
+        # the ISSUE 18 serving-speed rows: warm first route, fused
+        # steady window, post-ladder refresh — each inside its target
+        # and each faster than its committed cold baseline
+        from benchmarks.config15_hier import (
+            FIRST_ROUTE_WARM_MAX_MS,
+            REFRESH_WARM_MAX_MS,
+            STEADY_ROUTE_MAX_MS,
+        )
+
+        assert set(rows) >= {"15c", "15d", "15e"}, (
+            "serving-twin rows not committed"
+        )
+        first = rows["15c"]
+        assert first["metric"] == "hier_first_route_ms"
+        assert first["value"] < FIRST_ROUTE_WARM_MAX_MS
+        assert first["vs_baseline"] > 1.0
+        assert first["cold_ms"] == head["first_route_ms"]
+        assert "warm==scalar==restored" in first["fence"]
+        steady = rows["15d"]
+        assert steady["metric"] == "hier_steady_route_ms"
+        assert steady["value"] < STEADY_ROUTE_MAX_MS
+        assert steady["vs_baseline"] > 1.0
+        assert steady["n_pairs"] == head["n_pairs"]
+        refresh = rows["15e"]
+        assert refresh["metric"] == "hier_refresh_ms"
+        assert refresh["value"] < REFRESH_WARM_MAX_MS
+        assert refresh["vs_baseline"] > 1.0
+        assert refresh["cold_ms"] == head["refresh_ms"]
 
 
 def test_hier_ring_churn_repair_stays_fenced(virtual_mesh):
@@ -506,6 +550,296 @@ def test_hier_ring_churn_repair_stays_fenced(virtual_mesh):
             len(f) for f in ring.find_routes_batch(pairs)
         ], f"ring hier drifted from dense at churn step {step}"
     assert oracle.full_refresh_count == builds0, "repair path not taken"
+
+
+# -- warm ladder / fused composition / persistent border plane (ISSUE 18) --
+
+
+def test_hier_serving_knobs_default_on():
+    """The fused/warm/snapshot serving path is the default; the escape
+    hatches exist and actually reach the oracle."""
+    from sdnmpi_tpu.config import Config
+
+    cfg = Config()
+    assert cfg.hier_fused is True
+    assert cfg.hier_warm is True
+    assert cfg.hier_snapshot is True
+    spec = fattree(4)
+    on = spec.to_topology_db(backend="jax", hier_oracle=True)
+    off = spec.to_topology_db(
+        backend="jax", hier_oracle=True, hier_fused=False,
+        hier_warm=False,
+    )
+    assert on._jax_oracle().fused and on._jax_oracle().hier_warm
+    assert not off._jax_oracle().fused
+    assert not off._jax_oracle().hier_warm
+
+
+@pytest.mark.parametrize("topo", sorted(TOPOS))
+def test_hier_fused_bit_identical_to_scalar(topo):
+    """The fused composition kernel + batched path builder vs the
+    scalar escape hatch: hop-for-hop identical fdbs across window,
+    balanced/steered, and collective entry points (ISSUE 18's
+    tentpole fence)."""
+    spec = TOPOS[topo]()
+    fused = spec.to_topology_db(backend="jax", hier_oracle=True)
+    scal = spec.to_topology_db(
+        backend="jax", hier_oracle=True, hier_fused=False
+    )
+    pairs = _hosts_pairs(fused, n=8)
+    assert fused.find_routes_batch(pairs) == scal.find_routes_batch(pairs)
+    util = {(1, 1): 9e9, (2, 2): 3e9}
+    bf, mf = fused.find_routes_batch_balanced(pairs, link_util=util)
+    bs, ms = scal.find_routes_batch_balanced(pairs, link_util=util)
+    assert bf == bs and mf == ms
+    macs = sorted(fused.hosts)[:6]
+    n = len(macs)
+    src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    off = src != dst
+    si, di = src[off].astype(np.int32), dst[off].astype(np.int32)
+    cf = fused.find_routes_collective(macs, si, di, "balanced")
+    cs = scal.find_routes_collective(macs, si, di, "balanced")
+    assert cf.fdbs() == cs.fdbs()
+    assert cf.max_congestion == cs.max_congestion
+    np.testing.assert_array_equal(
+        np.asarray(cf.endpoint_port), np.asarray(cs.endpoint_port)
+    )
+
+
+def test_hier_fused_steering_bit_identical():
+    """The loaded-agg steering fence through the fused kernel: the
+    zero-load-plane collapse must reproduce the scalar tie-break
+    exactly, and a loaded border must steer identically."""
+    spec = fattree(4)
+    fused = spec.to_topology_db(backend="jax", hier_oracle=True)
+    scal = spec.to_topology_db(
+        backend="jax", hier_oracle=True, hier_fused=False
+    )
+    hosts = sorted(fused.hosts)
+    pairs = [(a, b) for a in hosts[:4] for b in hosts[4:8]]
+    util = {(5, p): 9e9 for p in range(1, 5)}
+    lf, _ = fused.find_routes_batch_balanced(pairs, link_util=util)
+    ls, _ = scal.find_routes_batch_balanced(pairs, link_util=util)
+    assert lf == ls
+    assert 5 not in {d for fdb in lf for d, _ in fdb}
+
+
+def test_hier_warm_ladder_zero_recompiles():
+    """warm_serving precompiles the whole pow2 program ladder: a
+    subsequent pow2 ladder of window shapes (growing destination-pod
+    spans) dispatches ZERO fresh composition traces
+    (count_trace-probed — the ISSUE 18 acceptance)."""
+    from sdnmpi_tpu.utils import tracing
+
+    spec = fattree(4, pods=6)
+    db = spec.to_topology_db(backend="jax", hier_oracle=True)
+    ws = db.warm_serving()
+    assert ws["compiled"] > 0
+    hosts = sorted(db.hosts)
+    tracing.TRACE_COUNTS.clear()
+    for n in (2, 4, 8, 16, 24):
+        hs = hosts[:n]
+        pairs = [(a, b) for a in hs for b in hs if a != b]
+        db.find_routes_batch(pairs)
+        db.find_routes_batch_balanced(
+            pairs, link_util={(1, 1): 9e9}
+        )
+    assert tracing.TRACE_COUNTS.get("hier_compose", 0) == 0, (
+        "the warm ladder missed a composition shape"
+    )
+
+
+def test_hier_warm_escape_hatch_skips_ladder():
+    spec = fattree(4)
+    db = spec.to_topology_db(
+        backend="jax", hier_oracle=True, hier_warm=False
+    )
+    ws = db.warm_serving()
+    assert ws["compiled"] == 0 and ws["max_len"] > 0
+
+
+def test_hier_border_cache_metrics_move():
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    hits = REGISTRY.get("hier_border_cache_hits_total")
+    misses = REGISTRY.get("hier_border_cache_misses_total")
+    cached = REGISTRY.get("hier_border_rows_cached")
+    h0, m0 = hits.value, misses.value
+    db = fattree(4).to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(db, n=6)
+    db.find_routes_batch(pairs)
+    assert misses.value > m0, "first window must fault rows in"
+    assert cached.value > 0
+    m1 = misses.value
+    db.find_routes_batch(pairs)
+    assert hits.value > h0 and misses.value == m1, (
+        "repeat window must hit the row cache"
+    )
+
+
+# -- the persistent border plane ------------------------------------------
+
+
+def test_hier_border_snapshot_roundtrip():
+    """Snapshot -> JSON wire -> restore into a fresh oracle: the
+    restored plane is byte-equal and routes identically; the
+    wire format survives json round-trips (the checkpoint file)."""
+    import json
+
+    spec = fattree(4, pods=6)
+    db = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(db, n=10)
+    f0 = db.find_routes_batch(pairs)
+    st0 = db._jax_oracle()._hier
+    snap = json.loads(json.dumps(db.hier_border_snapshot()))
+    assert snap["pods"], "materialized rows must persist"
+    db2 = spec.to_topology_db(backend="jax", hier_oracle=True)
+    restored = db2.hier_restore_border_rows(snap)
+    assert restored == sum(
+        d["shape"][0] for d in snap["pods"].values()
+    )
+    st2 = db2._jax_oracle()._hier
+    for p, r in st0.rows.items():
+        np.testing.assert_array_equal(r, st2.rows[p])
+    assert db2.find_routes_batch(pairs) == f0
+
+
+def test_hier_border_snapshot_rejects_never_crashes():
+    """Digest mismatch degrades to the cold lazy build with a counted
+    rejection; malformed snapshots are tolerated the same way (the
+    satellite-4 contract: never a crash)."""
+    from sdnmpi_tpu.core.topology_db import Link, Port
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    rejected = REGISTRY.get("hier_snapshot_rejected_total")
+    spec = fattree(4, pods=6)
+    db = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(db, n=8)
+    f0 = db.find_routes_batch(pairs)
+    snap = db.hier_border_snapshot()
+    other = spec.to_topology_db(backend="jax", hier_oracle=True)
+    a, pa, b, pb = spec.links[0]
+    other.delete_link(Link(Port(a, pa), Port(b, pb)))
+    other.delete_link(Link(Port(b, pb), Port(a, pa)))
+    r0 = rejected.value
+    assert other.hier_restore_border_rows(snap) == 0
+    assert rejected.value == r0 + 1
+    for garbage in (
+        {"version": 99}, "not a dict", {"version": 1, "digest": "x"},
+    ):
+        assert other.hier_restore_border_rows(garbage) == 0
+    assert rejected.value > r0 + 1
+    # and the cold path still routes
+    fresh = spec.to_topology_db(backend="jax", hier_oracle=True)
+    r1 = rejected.value
+    assert fresh.hier_restore_border_rows(snap) > 0
+    assert rejected.value == r1
+    assert fresh.find_routes_batch(pairs) == f0
+
+
+def test_hier_snapshot_churn_replay_fence():
+    """Seeded churn AFTER a restore: the delta log must invalidate the
+    restored plane exactly like a live one — every step's routes equal
+    a never-persisted twin's (the satellite-3 fence)."""
+    import random
+
+    from sdnmpi_tpu.core.topology_db import Link, Port
+
+    spec = fattree(4, pods=6)
+    donor = spec.to_topology_db(backend="jax", hier_oracle=True)
+    pairs = _hosts_pairs(donor, n=8)
+    donor.find_routes_batch(pairs)
+    snap = donor.hier_border_snapshot()
+
+    restored = spec.to_topology_db(backend="jax", hier_oracle=True)
+    assert restored.hier_restore_border_rows(snap) > 0
+    twin = spec.to_topology_db(backend="jax", hier_oracle=True)
+
+    rng = random.Random(29)
+    cables = list(spec.links)
+    removed = []
+    for step in range(10):
+        if removed and rng.random() < 0.5:
+            a, pa, b, pb = removed.pop()
+            for db in (restored, twin):
+                db.add_link(Link(Port(a, pa), Port(b, pb)))
+                db.add_link(Link(Port(b, pb), Port(a, pa)))
+        else:
+            a, pa, b, pb = cables[rng.randrange(len(cables))]
+            if restored.links.get(a, {}).get(b) is None:
+                continue
+            removed.append((a, pa, b, pb))
+            for db in (restored, twin):
+                db.delete_link(Link(Port(a, pa), Port(b, pb)))
+                db.delete_link(Link(Port(b, pb), Port(a, pa)))
+        assert restored.find_routes_batch(pairs) == twin.find_routes_batch(
+            pairs
+        ), f"restored plane drifted at churn step {step}"
+
+
+def test_controller_restart_roundtrip_restores_border_plane():
+    """The snapshot layer end to end (satellite 3): a controller
+    checkpoint carries the border plane, a restarted controller
+    restores it BEFORE reinstalling pairs, and the restored fabric
+    routes identically; with hier_snapshot off the key is absent."""
+    from sdnmpi_tpu.api.snapshot import (
+        restore_controller,
+        snapshot_controller,
+    )
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.protocol import openflow as of
+    from sdnmpi_tpu.protocol.announcement import (
+        Announcement,
+        AnnouncementType,
+    )
+
+    def boot(spec, config):
+        fabric = spec.to_fabric(wire=False)
+        controller = Controller(fabric, config)
+        controller.attach()
+        macs = sorted(fabric.hosts)[:4]
+        for rank, mac in enumerate(macs):
+            fabric.hosts[mac].send(of.Packet(
+                eth_src=mac, eth_dst="ff:ff:ff:ff:ff:ff",
+                eth_type=of.ETH_TYPE_IP, ip_proto=of.IPPROTO_UDP,
+                udp_dst=config.announcement_port,
+                payload=Announcement(
+                    AnnouncementType.LAUNCH, rank
+                ).encode(),
+            ))
+        return fabric, controller, macs
+
+    config = Config(hier_oracle=True)
+    fabric, controller, macs = boot(fattree(4), config)
+    db = controller.topology_manager.topologydb
+    pairs = [(a, b) for a in macs for b in macs if a != b]
+    f0 = db.find_routes_batch(pairs)
+    snap = snapshot_controller(controller)
+    assert snap["hier_border"] and snap["hier_border"]["pods"]
+
+    _, controller2, _ = boot(fattree(4), Config(hier_oracle=True))
+    restore_controller(controller2, snap)
+    db2 = controller2.topology_manager.topologydb
+    st2 = db2._jax_oracle()._hier
+    assert st2 is not None and st2.plane_len > 0, (
+        "restore did not seed the border plane"
+    )
+    assert db2.find_routes_batch(pairs) == f0
+
+    # knob off: the key is absent from fresh checkpoints and restores
+    # of old ones are skipped (the lazy cold build still routes)
+    _, controller3, _ = boot(
+        fattree(4), Config(hier_oracle=True, hier_snapshot=False)
+    )
+    snap3 = snapshot_controller(controller3)
+    assert snap3["hier_border"] is None
+    db3 = controller3.topology_manager.topologydb
+    calls = []
+    db3.hier_restore_border_rows = lambda s: calls.append(1)
+    restore_controller(controller3, snap)
+    assert not calls, "hier_snapshot=False must skip the restore"
+    assert db3.find_routes_batch(pairs) == f0
 
 
 def test_hier_zero_border_pod_routes_without_crash():

@@ -314,6 +314,31 @@ class TestRecoveryFlags:
         assert cfg.hier_pod_target == 64
         assert cfg.mesh_devices == 8
 
+    def test_hier_warm_flags_map_to_config(self):
+        """--hier-warm / --no-hier-warm wire Config.hier_warm (default
+        ON — the warm program ladder, ISSUE 18); last flag wins."""
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.hier_warm is True
+        cfg = launch.config_from_args(_parse(["--no-hier-warm"]))
+        assert cfg.hier_warm is False
+        cfg = launch.config_from_args(
+            _parse(["--no-hier-warm", "--hier-warm"])
+        )
+        assert cfg.hier_warm is True
+
+    def test_hier_snapshot_flags_map_to_config(self):
+        """--hier-snapshot / --no-hier-snapshot wire
+        Config.hier_snapshot (default ON — the border plane rides the
+        checkpoint, ISSUE 18); last flag wins."""
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.hier_snapshot is True
+        cfg = launch.config_from_args(_parse(["--no-hier-snapshot"]))
+        assert cfg.hier_snapshot is False
+        cfg = launch.config_from_args(
+            _parse(["--hier-snapshot", "--no-hier-snapshot"])
+        )
+        assert cfg.hier_snapshot is False
+
     def test_ring_exchange_flags_map_to_config(self):
         """--ring-exchange / --no-ring-exchange wire Config.ring_exchange
         (default off — the PR-9 gather path); the last flag wins."""
