@@ -1,0 +1,267 @@
+"""Config 17: measured traffic matrix + route-quality sentinel costs.
+
+The traffic plane (oracle/trafficplane.py, ISSUE 19) turns the audit
+sweep's attributed byte deltas into a device-resident per-tenant
+src->dst rate matrix, and the sentinel (control/sentinel.py) re-scores
+a paced sample of installed routes against a fresh oracle optimum for
+that measured matrix. This config prices the channel on a wire-mode
+fat-tree with a routed, pumped flow population:
+
+- ``traffic_update_ms`` (headline): wall of ONE TrafficPlane flush
+  (bucket-padded EWMA scatter + epoch publish) with a full audit
+  sweep's staged deltas, median over several sweeps. vs_baseline is
+  the piggyback ratio — the audit sweep wall the update rides on over
+  the update's own wall — i.e. "the measured matrix costs 1/N of the
+  channel that was already being paid for". Extras carry the dense
+  host-rebuild-and-upload alternative's wall (``host_rebuild_ms``) for
+  the incremental-vs-recompute comparison; at sim scale the dense
+  rebuild is small (the matrix is tiny), the device scatter's value is
+  that the matrix STAYS resident for the sentinel's shadow dispatch
+  and never re-uploads in steady state.
+- ``sentinel_sweep_ms`` (extra row): wall of one sentinel sweep at the
+  default pacing (``sentinel_sample_per_flush`` routes): measured-
+  weight lookup, installed-path walks, the pow2-padded balanced shadow
+  dispatch, and the load projection.
+- ``traffic_detect_sweeps`` (extra row): flush edges from a traffic-
+  pattern shift (one edge's hosts bursting cross-pod over paths that
+  share an uplink) to the sentinel's confirmed divergence — bounded at
+  <= 2 by construction (attribute -> publish -> score inside one edge,
+  plus one edge of stats-pull lag); the fence in
+  tests/test_trafficplane.py pins the same bound at test scale.
+
+Wire-mode sim + the default oracle backend (the balanced shadow leg is
+the device dispatch this PR actually ships).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+FATTREE_K = 8  # 80 switches, 128 hosts
+N_PAIRS = 256
+N_SWEEPS = 6
+
+
+def build(k: int = FATTREE_K, n_pairs: int = N_PAIRS):
+    """A wire-mode fat-tree with the audit plane full-fabric, the
+    traffic plane on a deterministic 1 Hz clock (rates == bytes per
+    sweep), and a routed pair population. Test-scale callers shrink
+    ``k``/``n_pairs``."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.controller import Controller
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    fabric = spec.to_fabric(wire=True)
+    config = Config(
+        enable_monitor=False,
+        coalesce_routes=True,
+        audit_switches_per_flush=0,
+        install_retry_backoff_s=0.0,
+        barrier_timeout_s=0.0,
+        sentinel_divergence_factor=1.5,
+    )
+    controller = Controller(fabric, config)
+    controller.attach()
+    assert controller.traffic is not None
+
+    t = [0.0]
+
+    def clk():
+        t[0] += 1.0
+        return t[0]
+
+    controller.traffic.clock = clk
+
+    rng = np.random.default_rng(17)
+    hosts = sorted(fabric.hosts)
+    pairs = set()
+    while len(pairs) < min(n_pairs, len(hosts) * (len(hosts) - 1)):
+        a, b = rng.choice(len(hosts), size=2, replace=False)
+        pairs.add((hosts[a], hosts[b]))
+    pairs = sorted(pairs)
+    controller.router.reinstall_pairs(pairs)
+    return spec, fabric, controller, pairs
+
+
+def pump(fabric, pairs) -> None:
+    from sdnmpi_tpu.protocol import openflow as of
+
+    for src, dst in pairs:
+        fabric.hosts[src].send(of.Packet(src, dst, of.ETH_TYPE_IP))
+
+
+def update_walls_ms(controller, fabric, pairs, n_sweeps: int = N_SWEEPS):
+    """(audit sweep walls, TrafficPlane flush walls) over real sweeps of
+    pumped traffic — the flush alone is the headline, the audit wall is
+    its piggyback baseline (config 16 prices the audit itself)."""
+    audit_walls, flush_walls = [], []
+    for _ in range(n_sweeps):
+        pump(fabric, pairs)
+        t0 = time.perf_counter()
+        controller.audit.sweep()
+        audit_walls.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        controller.traffic.flush()
+        flush_walls.append((time.perf_counter() - t0) * 1e3)
+    return audit_walls, flush_walls
+
+
+def host_rebuild_ms(controller, n_rounds: int = N_SWEEPS) -> float:
+    """The recompute-from-scratch alternative: densify the published
+    cells into a host [T * P * P] array and re-upload, per sweep."""
+    import jax.numpy as jnp
+
+    traffic = controller.traffic
+    host = np.asarray(traffic._snap)
+    cells = {i: float(host[i]) for i in traffic._active}
+    walls = []
+    for _ in range(n_rounds):
+        t0 = time.perf_counter()
+        dense = np.zeros(traffic._cells(), dtype=np.float32)
+        for i, v in cells.items():
+            dense[i] = v
+        jnp.asarray(dense).block_until_ready()
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(walls))
+
+
+def sentinel_walls_ms(controller, fabric, pairs,
+                      n_sweeps: int = N_SWEEPS):
+    """Wall of one sentinel sweep at the default sample pacing, with
+    measured weights live (the shadow dispatch actually runs)."""
+    walls = []
+    for _ in range(n_sweeps):
+        pump(fabric, pairs)
+        controller.audit.sweep()
+        controller.traffic.flush()
+        t0 = time.perf_counter()
+        controller.sentinel.sweep()
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return walls
+
+
+def measure_detection(k: int = FATTREE_K) -> int:
+    """Flush edges from a traffic-pattern shift to the sentinel's
+    confirmed divergence (the detection-latency row; <= 2 by
+    construction). Builds its own small soak so the steady phase is
+    clean. Also the test-scale fence's entry point."""
+    from sdnmpi_tpu.control import events as ev
+    from sdnmpi_tpu.utils.metrics import REGISTRY
+
+    from sdnmpi_tpu.protocol import openflow as of
+
+    _spec, fabric, controller, _pairs = build(k=k, n_pairs=0)
+    controller.config.sentinel_sample_per_flush = 0
+    hosts_by_edge: dict[int, list[str]] = {}
+    for mac in sorted(fabric.hosts):
+        hosts_by_edge.setdefault(fabric.hosts[mac].dpid, []).append(mac)
+    order = sorted(hosts_by_edge)
+    # steady = intra-edge pairs (installed path == optimum == zero
+    # fabric links, so the sentinel scores them divergence-free by
+    # construction); shift = one edge's hosts bursting to hosts in the
+    # last two (remote-pod) edges over shortest paths that pile onto a
+    # shared uplink the balanced shadow would spread
+    steady = [
+        (h[i], h[i + 1])
+        for e in order[: len(order) // 2]
+        for h in [hosts_by_edge[e]]
+        for i in range(0, len(h) - 1, 2)
+    ]
+    shift = [
+        (s, hosts_by_edge[e][0])
+        for s in hosts_by_edge[order[0]]
+        for e in order[-2:]
+    ]
+    controller.router.reinstall_pairs(steady + shift)
+
+    def edge(counts):
+        for (src, dst), n in counts.items():
+            for _ in range(n):
+                fabric.hosts[src].send(
+                    of.Packet(src, dst, of.ETH_TYPE_IP)
+                )
+        controller.bus.publish(ev.EventStatsFlush())
+
+    # the labeled family is process-global: score NEW confirmations
+    # against where the counter stood at entry (main() runs the wall
+    # phases — which may legitimately confirm divergence on random
+    # traffic — in the same process first)
+    fam = REGISTRY.get("sentinel_divergence_total")
+
+    def confirmations() -> float:
+        return sum(dict(fam.values).values())
+
+    base = confirmations()
+    for _ in range(5):
+        edge({p: 1 for p in steady})
+    assert confirmations() == base, (
+        "false positive during the steady phase"
+    )
+    for i in range(1, 5):
+        edge({p: 2 for p in shift})
+        if confirmations() > base:
+            return i
+    return -1
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    _spec, fabric, controller, pairs = build()
+    n_flows = controller.router.recovery.desired.total()
+    log(
+        f"built fat-tree k={FATTREE_K}: {len(fabric.switches)} switches, "
+        f"{n_flows} desired flows for {len(pairs)} pairs "
+        f"({time.perf_counter() - t0:.1f}s)"
+    )
+
+    audit_walls, walls = update_walls_ms(controller, fabric, pairs)
+    headline = float(np.median(walls))
+    audit_ms = float(np.median(audit_walls))
+    rebuild = host_rebuild_ms(controller)
+    active = len(controller.traffic._active)
+    log(
+        f"matrix flush: {headline:.3f} ms median ({active} active cells)"
+        f" riding a {audit_ms:.1f} ms audit sweep; dense host "
+        f"rebuild+upload {rebuild:.3f} ms"
+    )
+
+    sentinel_walls = sentinel_walls_ms(controller, fabric, pairs)
+    sentinel = float(np.median(sentinel_walls))
+    log(f"sentinel sweep (sample="
+        f"{controller.config.sentinel_sample_per_flush}): "
+        f"{sentinel:.2f} ms median")
+
+    detect = measure_detection()
+    assert detect != -1, "pattern shift never detected"
+    log(f"detection latency: {detect} flush edge(s) from shift to "
+        f"confirmed divergence")
+
+    emit(
+        "traffic_update_ms", headline, "ms",
+        vs_baseline=audit_ms / headline if headline else 0.0,
+        audit_sweep_ms=round(audit_ms, 3),
+        host_rebuild_ms=round(rebuild, 3),
+        n_active_cells=active,
+        n_switches=len(fabric.switches),
+        update_walls_ms=[round(w, 3) for w in walls],
+    )
+    emit(
+        "sentinel_sweep_ms", sentinel, "ms",
+        vs_baseline=1.0,  # no reference figure: the reference never scores
+        sample_per_flush=controller.config.sentinel_sample_per_flush,
+        sweep_walls_ms=[round(w, 3) for w in sentinel_walls],
+    )
+    emit(
+        "traffic_detect_sweeps", float(detect), "sweeps",
+        vs_baseline=1.0,
+        divergence_factor=controller.config.sentinel_divergence_factor,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,10 @@ SOAK_EXEMPT = {
     "audit_heals_total",  # a healthy fabric has nothing to heal
     "fabric_diverged_switches",  # 0 IS the healthy reading
     "slo_burn_triggers_total",  # an SLO burn is an incident
+    "sentinel_divergence_total",  # a confirmed divergence is an incident
+    "sentinel_heals_total",  # opt-in (--sentinel-heal) incident response
+    "trafficplane_unmapped_total",  # counts rows audit cannot attribute
+    "route_staleness_ratio",  # 0 IS the healthy reading (no stale routes)
     "flight_dumps_total",  # needs a dump dir
     "profile_captures_total",  # needs --profile-dump + an anomaly
     "router_reval_flows_drained_total",  # needs a drained re-route
@@ -73,6 +77,10 @@ SOAK_EXEMPT = {
     # (DAG threshold) — config 12/15 assert them at bench scale
     "congestion_fractional_max",
     "congestion_discrete_over_fractional",
+    # capacity growth the soak's fattree(4) never needs (8 endpoints /
+    # 3 tenants fit the traffic plane's initial pow2 caps exactly) —
+    # tests/test_trafficplane.py exercises the regrow path
+    "trafficplane_rebuilds_total",
     # real-TCP southbound only (OFSouthbound windows/slices; the lint
     # soaks the simulated wire fabric — tests/test_southbound.py
     # asserts these over a live socket)
@@ -153,6 +161,12 @@ def soak(duration_requests: int = 48) -> None:
             # move without starving the serving rounds
             admission_rate=0.0,
             admission_burst=8.0,
+            # full-fabric audit per flush edge: the data-plane pump
+            # below needs every edge switch's counters diffed within
+            # the soak's few flushes (pacing would round-robin past
+            # them) so the traffic matrix and sentinel actually see
+            # attributed deltas
+            audit_switches_per_flush=0,
             slo_targets={"t0": (50.0, 0.999)},
             event_log=str(pathlib.Path(td) / "events.jsonl"),
             flow_idle_timeout=0,
@@ -252,6 +266,22 @@ def soak(duration_requests: int = 48) -> None:
             ))
         controller.router.flush_routes()
         controller.bus.publish(ev.EventStatsFlush())
+
+        # data-plane pump over the installed serving windows, LAST:
+        # the audit sweeps on these flush edges attribute REAL per-flow
+        # byte deltas (earlier sweeps established the baselines), the
+        # measured traffic matrix stages and scatters them, and the
+        # sentinel's shadow dispatch scores the live cells — ordered
+        # after the storm so the final flush leaves the matrix
+        # populated (a traffic-free trailing flush at the default
+        # alpha=1.0 would clear the active-cell/hot-pair gauges back
+        # to zero)
+        for _ in range(3):
+            for src, dst in pairs[:8]:
+                fabric.hosts[src].send(
+                    of.Packet(src, dst, of.ETH_TYPE_IP)
+                )
+            controller.bus.publish(ev.EventStatsFlush())
         controller.event_logger.close()
 
 

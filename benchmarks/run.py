@@ -41,6 +41,7 @@ CONFIGS = [
     ("14", [sys.executable, "-m", "benchmarks.config14_serving"]),
     ("15", [sys.executable, "-m", "benchmarks.config15_hier"]),
     ("16", [sys.executable, "-m", "benchmarks.config16_audit"]),
+    ("17", [sys.executable, "-m", "benchmarks.config17_traffic"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

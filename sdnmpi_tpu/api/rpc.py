@@ -187,6 +187,9 @@ class RPCInterface:
     #                           history (ISSUE 14): {series: {name:
     #                           [[ts, value], ...]}} over the bounded
     #                           multi-resolution ring; names filters
+    #   traffic_matrix()     -> the published measured traffic matrix
+    #                           (ISSUE 19): {epoch, mode, endpoints,
+    #                           cells: [[tenant, src, dst, bps], ...]}
 
     #: method name -> (request factory, reply-attribute extractor)
     PULL_METHODS = {
@@ -199,6 +202,8 @@ class RPCInterface:
         "timeline": (lambda params: ev.TimelineRequest(
                          _timeline_names(params)),
                      lambda reply: reply.timeline),
+        "traffic_matrix": (lambda params: ev.TrafficMatrixRequest(),
+                           lambda reply: reply.matrix),
     }
 
     def handle_request(self, message: dict):

@@ -53,10 +53,40 @@ def snapshot_controller(controller) -> dict:
         db.hier_border_snapshot()
         if getattr(cfg, "hier_snapshot", True) else None
     )
+    # the audit plane's per-row counter baselines ride beside the
+    # desired-store checkpoint (ISSUE 19 satellite), digest-guarded: a
+    # restarted controller that re-baselined from scratch would
+    # attribute each switch's LIFETIME counters as a fresh delta on its
+    # first sweep — spiking tenant bytes, the traffic matrix, and any
+    # divergence trigger watching them.
+    audit = getattr(controller, "audit", None)
+    audit_baselines = (
+        {
+            "topology_digest": RouteCache.topology_digest(db),
+            "cycle": audit.cycle,
+            "rows": [
+                [dpid, src, dst, pkts, bts]
+                for dpid, table in sorted(audit._counters.items())
+                for (src, dst), (pkts, bts) in sorted(table.items())
+            ],
+        }
+        if audit is not None else None
+    )
+    # the measured traffic matrix's EWMA state rides too (cells keyed
+    # by tenant/endpoint NAMES; the plane re-resolves them against the
+    # live fabric on restore), under the same digest guard
+    traffic = getattr(controller, "traffic", None)
+    traffic_plane = (
+        dict(traffic.state_dict(),
+             topology_digest=RouteCache.topology_digest(db))
+        if traffic is not None else None
+    )
     return {
         "version": SNAPSHOT_VERSION,
         "route_cache": route_cache,
         "hier_border": hier_border,
+        "audit_baselines": audit_baselines,
+        "traffic_plane": traffic_plane,
         "desired_flows": {
             "topology_digest": RouteCache.topology_digest(db),
             "rows": [
@@ -146,6 +176,38 @@ def restore_controller(controller, snapshot: dict) -> None:
                     int(dpid), src, dst, int(out_port), rewrite,
                     bool(collective),
                 )
+
+    # Re-seed the audit plane's counter baselines (ISSUE 19 satellite)
+    # under the same digest guard: the first post-restore sweep then
+    # diffs against where the counters stood at checkpoint instead of
+    # attributing each switch's lifetime counters as one giant fresh
+    # delta. (A switch that redialed meanwhile reset its counters;
+    # the attribution path re-baselines on counters-went-backwards,
+    # so a stale baseline degrades to the old behavior, never a spike.)
+    from sdnmpi_tpu.oracle.routecache import RouteCache
+
+    aud = snapshot.get("audit_baselines")
+    audit = getattr(controller, "audit", None)
+    if (
+        aud and audit is not None
+        and aud.get("topology_digest") == RouteCache.topology_digest(db)
+    ):
+        audit.cycle = int(aud.get("cycle", 0))
+        for dpid, src, dst, pkts, bts in aud.get("rows", []):
+            audit._counters.setdefault(int(dpid), {})[(src, dst)] = (
+                int(pkts), int(bts)
+            )
+
+    # ... and the measured traffic matrix's EWMA state, so the sentinel
+    # scores against the learned matrix instead of a blank one until
+    # traffic re-accumulates
+    tp = snapshot.get("traffic_plane")
+    traffic = getattr(controller, "traffic", None)
+    if (
+        tp and traffic is not None
+        and tp.get("topology_digest") == RouteCache.topology_digest(db)
+    ):
+        traffic.load_state(tp)
 
     # Re-seed the route-cache memo BEFORE any re-routing below: the
     # reinstall passes then hit the restored entries (hit == miss

@@ -165,6 +165,8 @@ INSTRUMENTED_MODULES = (
     "sdnmpi_tpu.control.monitor",
     "sdnmpi_tpu.control.topology_manager",
     "sdnmpi_tpu.control.fabric",
+    "sdnmpi_tpu.control.sentinel",
+    "sdnmpi_tpu.oracle.trafficplane",
     "sdnmpi_tpu.oracle.engine",
     "sdnmpi_tpu.oracle.utilplane",
     "sdnmpi_tpu.oracle.incremental",
@@ -200,6 +202,7 @@ METRIC_OWNERS = (
     ("install_", "control/recovery"),
     ("jit_compile_", "utils/devprof"),
     ("jit_", "utils/tracing"),
+    ("measured_vs_modeled_", "control/sentinel"),
     ("monitor_", "control/monitor"),
     ("oracle_", "oracle/engine"),
     ("pipeline_", "control/router"),
@@ -209,6 +212,8 @@ METRIC_OWNERS = (
     ("reval_", "control/router"),
     ("ring_", "shardplane"),
     ("route_cache_", "oracle/routecache"),
+    ("route_staleness_", "control/sentinel"),
+    ("sentinel_", "control/sentinel"),
     ("router_", "control/router"),
     ("sched_", "control/router"),
     ("serving_warmup_", "oracle/engine"),
@@ -217,6 +222,7 @@ METRIC_OWNERS = (
     ("southbound_", "control/southbound"),
     ("topology_", "core/topology_db"),
     ("trace_", "utils/tracing"),
+    ("trafficplane_", "oracle/trafficplane"),
     ("utilplane_", "oracle/utilplane"),
 )
 

@@ -380,6 +380,38 @@ class Config:
     #: ordinary traffic cessation looks like.
     audit_confirm_sweeps: int = 2
 
+    # --- measured traffic plane + route sentinel (ISSUE 19) ---------------
+    #: device-resident per-tenant src->dst byte-rate matrix
+    #: (oracle/trafficplane.py) fed by the audit plane's per-row counter
+    #: deltas — one jitted bucket-padded EWMA scatter per sweep, the
+    #: UtilPlane idiom applied to MEASURED traffic. Pod-aggregated under
+    #: ``hier_oracle`` so the matrix scales to the 65k-switch fabric.
+    #: Arms only when the audit plane armed (it is the ingest source).
+    traffic_plane: bool = True
+    #: EWMA fold of each flush's measured rates into the matrix
+    #: (``r' = (1 - a) * r + a * sample``). 1.0 (default) is pure
+    #: replacement — the matrix equals the last sweep interval's
+    #: measured rates bit-exactly (the soak fence); < 1 smooths bursts.
+    traffic_ewma_alpha: float = 1.0
+    #: installed routes re-scored per stats flush by the shadow
+    #: route-quality sentinel (control/sentinel.py): a round-robin
+    #: sample is re-routed through the oracle's balanced batch dispatch
+    #: (pow2-bucketed — bounded trace space) and the measured matrix is
+    #: projected onto installed vs fresh paths. 0 = the whole installed
+    #: population every flush.
+    sentinel_sample_per_flush: int = 64
+    #: measured-vs-modeled divergence ratio (hottest measured link load
+    #: under the INSTALLED path assignment / under a fresh oracle
+    #: optimum for the same measured traffic) at which the sentinel
+    #: confirms the routes no longer fit the traffic: counts
+    #: ``sentinel_divergence_total{tenant}`` and freezes a flight
+    #: bundle naming the worst (tenant, collective, pod-pair).
+    sentinel_divergence_factor: float = 2.0
+    #: let the sentinel re-drive the worst diverging pair through the
+    #: install plane when divergence confirms. Default OFF: the channel
+    #: observes only and never mutates routing until a later PR opts in.
+    sentinel_heal: bool = False
+
     # --- recovery plane (control/recovery.py; ISSUE 5) --------------------
     #: master switch for the failure-domain recovery plane: desired-flow
     #: reconciliation on EventDatapathUp, the bounded install retry

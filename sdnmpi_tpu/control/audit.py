@@ -100,6 +100,12 @@ _m_tenant_bytes = REGISTRY.labeled_counter(
 )
 
 
+#: sweep intervals the congestion report's measured block averages
+#: over — long enough to smooth pull jitter, short enough that the
+#: measured-vs-modeled comparison tracks the current workload
+REPORT_WINDOW_SWEEPS = 8
+
+
 def _parse_row_actions(actions) -> Optional[tuple[int, Optional[str]]]:
     """(out_port, rewrite MAC | None) of a Router-shaped action tuple,
     None when the layout is not one the Router installs (including the
@@ -195,6 +201,16 @@ class AuditPlane:
         self.collective_bytes: dict[int, int] = {}
         self._indexed_cookies: frozenset = frozenset()
         self._cookie_idx: dict = {}
+        #: measured traffic matrix fed per attributed source-edge byte
+        #: delta (oracle/trafficplane.py; wired by the Controller)
+        self.traffic = None
+        #: (clock, tenant-bytes, collective-bytes) register snapshots
+        #: taken at each sweep close — the windowed measured block that
+        #: report() diffs (lifetime counters vs an instantaneous model
+        #: would be dimensionally dishonest)
+        self._window: collections.deque = collections.deque(
+            maxlen=REPORT_WINDOW_SWEEPS + 1
+        )
 
     # -- wiring seams ------------------------------------------------------
 
@@ -237,22 +253,52 @@ class AuditPlane:
         }
 
     def report(self) -> dict:
-        """The congestion report's measured block: observed bytes per
-        tenant and per collective install, beside each install's
-        MODELED congestion figure — measured truth vs the PR-8
-        scheduler's model, in one place."""
+        """The congestion report's measured block, WINDOWED: byte
+        deltas and rates over the last :data:`REPORT_WINDOW_SWEEPS`
+        sweep intervals per tenant and per collective install, beside
+        each install's MODELED congestion figure. The old block put
+        lifetime-cumulative counters next to an instantaneous modeled
+        figure — a long-lived tenant dwarfed any model simply by being
+        old — so the measured column is now a delta/rate over the
+        audit's own sweep clock (lifetime totals stay available under
+        ``*_total`` keys)."""
         live = {i.cookie: i for i in self.router.collectives}
         for cookie in list(self.collective_bytes):
             if cookie not in live:
                 del self.collective_bytes[cookie]
+        if len(self._window) >= 2:
+            t0, tenants0, colls0 = self._window[0]
+            t1, tenants1, colls1 = self._window[-1]
+            window_s = max(t1 - t0, 0.0)
+        else:
+            # fewer than two sweep edges: the window IS the lifetime
+            tenants0, colls0 = {}, {}
+            tenants1 = dict(_m_tenant_bytes.values)
+            colls1 = dict(self.collective_bytes)
+            window_s = 0.0
+        rate = (1.0 / window_s) if window_s > 0.0 else 0.0
+        tenant_win = {
+            t: int(tenants1.get(t, 0) - tenants0.get(t, 0))
+            for t in sorted(set(tenants0) | set(tenants1))
+        }
         return {
-            "tenant_bytes": {
+            "window_s": window_s,
+            "window_sweeps": max(len(self._window) - 1, 0),
+            "tenant_bytes": tenant_win,
+            "tenant_bps": {t: v * rate for t, v in tenant_win.items()},
+            "tenant_bytes_total": {
                 t: int(v) for t, v in sorted(_m_tenant_bytes.values.items())
             },
             "collectives": [
                 {
                     "cookie": cookie,
                     "measured_bytes": int(
+                        colls1.get(cookie, 0) - colls0.get(cookie, 0)
+                    ),
+                    "measured_bps": (
+                        colls1.get(cookie, 0) - colls0.get(cookie, 0)
+                    ) * rate,
+                    "measured_bytes_total": int(
                         self.collective_bytes.get(cookie, 0)
                     ),
                     "modeled_congestion": float(inst.max_congestion),
@@ -323,6 +369,13 @@ class AuditPlane:
             _m_sweeps.inc()
             _m_sweep_s.observe(time.perf_counter() - t0)
             _m_diverged.set(len(self._diverged))
+        # close the sweep on the report window: the measured block
+        # diffs these register snapshots instead of lifetime totals
+        self._window.append((
+            self.clock(),
+            dict(_m_tenant_bytes.values),
+            dict(self.collective_bytes),
+        ))
         return confirmed
 
     def _audit_switch(self, dpid: int) -> Optional[list[dict]]:
@@ -412,6 +465,14 @@ class AuditPlane:
                 _m_tenant_bytes.inc(
                     tenant if tenant is not None else "-", d_bytes
                 )
+                if self.traffic is not None:
+                    # the plane itself enforces source-edge attribution
+                    # (each flow's bytes enter the matrix once, not
+                    # once per audited hop)
+                    self.traffic.ingest(
+                        dpid, src, row[1],
+                        tenant if tenant is not None else "-", d_bytes,
+                    )
                 cookie = cookie_idx.get((dpid, row[0], row[1]))
                 if cookie is not None:
                     self.collective_bytes[cookie] = (

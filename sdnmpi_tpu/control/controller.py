@@ -145,6 +145,33 @@ class Controller:
                 ev.EventStatsFlush, lambda e: self.audit.sweep()
             )
 
+        # measured traffic matrix + shadow route-quality sentinel
+        # (ISSUE 19): the audit sweep's attributed byte deltas feed a
+        # device-resident per-tenant src->dst rate matrix
+        # (oracle/trafficplane.py), and each flush re-scores a paced
+        # sample of installed routes against a fresh oracle optimum for
+        # that measured matrix (control/sentinel.py). Subscribed AFTER
+        # the audit sweep (the flush that feeds the matrix) and BEFORE
+        # the flight recorder (the trigger pass must see this flush's
+        # divergence counters).
+        self.traffic = None
+        self.sentinel = None
+        if self.audit is not None and config.traffic_plane:
+            from sdnmpi_tpu.control.sentinel import RouteSentinel
+            from sdnmpi_tpu.oracle.trafficplane import TrafficPlane
+
+            self.traffic = TrafficPlane(
+                self.topology_manager.topologydb, config
+            )
+            self.audit.traffic = self.traffic
+            self.sentinel = RouteSentinel(
+                config, self.router, self.topology_manager.topologydb,
+                self.traffic, audit=self.audit,
+            )
+            self.bus.subscribe(
+                ev.EventStatsFlush, lambda e: self._traffic_tick()
+            )
+
         # anomaly-armed profiler capture (ISSUE 14): a firing trigger
         # opens a jax.profiler window for profile_capture_s seconds
         self.profile_capture = None
@@ -200,6 +227,12 @@ class Controller:
                 # bundle's detail names the switch and rows (ISSUE 15)
                 flight.triggers.append(self.audit.trigger())
                 flight.add_context("audit", self.audit.forensics)
+            if self.sentinel is not None:
+                # routes-don't-fit-the-traffic: the frozen bundle's
+                # detail names the worst (tenant, collective, pod-pair)
+                # and the context carries the measured matrix (ISSUE 19)
+                flight.triggers.append(self.sentinel.trigger())
+                flight.add_context("traffic", self.sentinel.forensics)
             flight.on_anomaly = self._publish_anomaly
             flight.arm()
             self.bus.tap(flight.event_tap)
@@ -237,6 +270,7 @@ class Controller:
         self.bus.provide(ev.SpanTreeRequest, self._span_tree)
         self.bus.provide(ev.FlightDumpRequest, self._flight_dump)
         self.bus.provide(ev.TimelineRequest, self._timeline)
+        self.bus.provide(ev.TrafficMatrixRequest, self._traffic_matrix)
 
     #: the route/install/re-route latency histograms the flight
     #: recorder's latency/p99 triggers watch (ISSUE 7)
@@ -306,6 +340,24 @@ class Controller:
             else {"series": {}, "n_rows": 0, "span_s": 0.0}
         )
         return ev.TimelineReply(timeline)
+
+    def _traffic_tick(self) -> None:
+        """Per-flush measured-traffic step: publish the matrix epoch the
+        audit sweep just staged, then let the sentinel re-score against
+        it (runs after audit.sweep by subscription order, before the
+        flight recorder's trigger pass)."""
+        self.traffic.flush()
+        self.sentinel.sweep()
+
+    def _traffic_matrix(self, req) -> "object":
+        from sdnmpi_tpu.control import events as ev
+
+        matrix = (
+            self.traffic.matrix()
+            if self.traffic is not None
+            else {"epoch": 0, "mode": "off", "endpoints": [], "cells": []}
+        )
+        return ev.TrafficMatrixReply(matrix)
 
     def _publish_anomaly(self, bundle: dict) -> None:
         """Flight-recorder anomaly hook -> one EventAnomaly on the bus

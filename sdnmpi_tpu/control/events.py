@@ -497,6 +497,24 @@ class TimelineReply(Reply):
 
 
 @dataclasses.dataclass
+class TrafficMatrixRequest(Request):
+    """The published measured traffic matrix (ISSUE 19,
+    oracle/trafficplane.py): per-tenant src->dst byte rates recovered
+    from the audit plane's flow-stats deltas, pod-aggregated under the
+    hierarchical oracle. Provided by the Controller; the
+    ``traffic_matrix()`` pull RPC rides it. Cells are
+    ``[tenant, src_endpoint, dst_endpoint, bps]``; mode is "off" when
+    the plane is disabled."""
+
+    dst = "Controller"
+
+
+@dataclasses.dataclass
+class TrafficMatrixReply(Reply):
+    matrix: dict
+
+
+@dataclasses.dataclass
 class CongestionReportRequest(Request):
     """The device-side congestion analytics of the latest Monitor pass
     (ISSUE 7): top-k hot links, per-collective attribution (which

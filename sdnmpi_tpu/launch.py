@@ -173,6 +173,14 @@ def config_from_args(args) -> Config:
         audit_switches_per_flush=getattr(
             args, "audit_switches_per_flush", 64
         ),
+        traffic_plane=not getattr(args, "no_traffic_plane", False),
+        sentinel_sample_per_flush=getattr(
+            args, "sentinel_sample_per_flush", 64
+        ),
+        sentinel_divergence_factor=getattr(
+            args, "sentinel_divergence_factor", 2.0
+        ),
+        sentinel_heal=getattr(args, "sentinel_heal", False),
         reconcile_max_per_flush=getattr(
             args, "reconcile_max_per_flush", 0
         ),
@@ -661,6 +669,35 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="N",
         help="switches audited per Monitor flush (the sweep's "
         "round-robin pacing; 0 = the whole fabric every flush)",
+    )
+    parser.add_argument(
+        "--no-traffic-plane", action="store_true",
+        help="disable the measured traffic matrix + shadow route-"
+        "quality sentinel (oracle/trafficplane.py, control/sentinel.py):"
+        " per-flush EWMA folding of the audit plane's attributed byte "
+        "deltas into a device-resident per-tenant src->dst rate matrix,"
+        " re-scored against a fresh oracle optimum",
+    )
+    parser.add_argument(
+        "--sentinel-sample-per-flush", type=_nonneg_int, default=64,
+        metavar="N",
+        help="installed routes the sentinel re-scores per stats flush "
+        "against a fresh oracle optimum for the measured matrix "
+        "(round-robin pacing; 0 = the whole installed population)",
+    )
+    parser.add_argument(
+        "--sentinel-divergence-factor", type=_pos_float, default=2.0,
+        metavar="F",
+        help="measured-vs-modeled hottest-link ratio at which the "
+        "sentinel confirms the routes no longer fit the traffic "
+        "(counts sentinel_divergence_total{tenant} and freezes a "
+        "flight bundle naming the worst tenant/collective/pod-pair)",
+    )
+    parser.add_argument(
+        "--sentinel-heal", action="store_true",
+        help="let the sentinel re-drive the worst diverging pair "
+        "through the install plane when divergence confirms (default "
+        "OFF: the channel observes only and never mutates routing)",
     )
     parser.add_argument(
         "--reconcile-max-per-flush", type=_nonneg_int, default=0,

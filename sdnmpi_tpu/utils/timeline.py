@@ -72,6 +72,9 @@ DEFAULT_TRACKS = (
     "coalescer_queue_depth",
     "pipeline_inflight_windows",
     "fabric_divergence_total",
+    "trafficplane_hot_pair_bps",
+    "route_staleness_ratio",
+    "measured_vs_modeled_divergence",
 )
 
 #: labeled-family -> timeline channel mapping (ISSUE 15 satellite).
@@ -91,8 +94,10 @@ LABELED_CHANNELS = {
     "flight_anomalies_total": "sum",
     "jit_compile_seconds": "sum",
     "jit_traces_total": "sum",
+    "sentinel_divergence_total": "sum",
     "slo_burn_triggers_total": "sum",
     "slo_route_latency_seconds": "sum",
+    "trafficplane_tenant_bytes_total": "sum",
 }
 
 

@@ -489,3 +489,41 @@ class TestObservabilityFlags:
             "--profile-dump", "/tmp/prof",
         ]))
         assert cfg.profile_dump_dir == "/tmp/prof"
+
+
+class TestTrafficPlaneFlags:
+    """--no-traffic-plane / --sentinel-* (ISSUE 19): matrix on by
+    default, paced sentinel sampling, divergence healing opt-in."""
+
+    def test_defaults(self):
+        cfg = launch.config_from_args(_parse([]))
+        assert cfg.traffic_plane is True
+        assert cfg.sentinel_sample_per_flush == 64
+        assert cfg.sentinel_divergence_factor == 2.0
+        assert cfg.sentinel_heal is False  # healing is OPT-IN
+
+    def test_flags_map_to_config(self):
+        cfg = launch.config_from_args(_parse([
+            "--no-traffic-plane",
+            "--sentinel-sample-per-flush", "16",
+            "--sentinel-divergence-factor", "1.5",
+            "--sentinel-heal",
+        ]))
+        assert cfg.traffic_plane is False
+        assert cfg.sentinel_sample_per_flush == 16
+        assert cfg.sentinel_divergence_factor == 1.5
+        assert cfg.sentinel_heal is True
+
+    def test_sample_zero_means_whole_population(self):
+        """0 is a legal pacing value (score everything every flush);
+        negatives fail the parse."""
+        import pytest
+
+        cfg = launch.config_from_args(_parse([
+            "--sentinel-sample-per-flush", "0",
+        ]))
+        assert cfg.sentinel_sample_per_flush == 0
+        with pytest.raises(SystemExit):
+            _parse(["--sentinel-sample-per-flush", "-1"])
+        with pytest.raises(SystemExit):
+            _parse(["--sentinel-divergence-factor", "0"])

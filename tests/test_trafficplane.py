@@ -1,0 +1,495 @@
+"""Measured traffic-matrix observatory + shadow route-quality sentinel
+(ISSUE 19).
+
+TrafficPlane ground-truth fencing (bit-exact at alpha=1.0, bounded
+EWMA error otherwise), source-edge single-count attribution, pod
+aggregation, the sentinel's steady-replay zero-false-positive fence
+and traffic-shift detection (with the flight bundle naming the
+diverging tenant/pod-pair), the pow2 zero-recompile probe over the
+shadow dispatch ladder, the windowed congestion-report satellite, and
+baseline/EWMA persistence through api/snapshot.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from sdnmpi_tpu.config import Config
+from sdnmpi_tpu.control import events as ev
+from sdnmpi_tpu.control.controller import Controller
+from sdnmpi_tpu.protocol import openflow as of
+from sdnmpi_tpu.topogen import fattree
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _registry_reset():
+    yield
+    REGISTRY.reset()
+
+
+def build(wire: bool = True, **overrides):
+    """A small fat-tree controller with the audit plane full-fabric,
+    the sentinel sampling everything, and a deterministic 1 Hz sweep
+    clock on the traffic plane (rates == bytes-per-sweep)."""
+    spec = fattree(4)
+    fabric = spec.to_fabric(wire=wire)
+    kwargs = dict(
+        coalesce_routes=True,
+        audit_switches_per_flush=0,
+        install_retry_backoff_s=0.0,
+        barrier_timeout_s=0.0,
+        sentinel_sample_per_flush=0,
+        sentinel_divergence_factor=1.5,
+    )
+    kwargs.update(overrides)
+    config = Config(**kwargs)
+    controller = Controller(fabric, config)
+    controller.attach()
+    assert controller.audit is not None
+    if controller.traffic is not None:
+        t = [0.0]
+
+        def clk():
+            t[0] += 1.0
+            return t[0]
+
+        controller.traffic.clock = clk
+    return fabric, controller
+
+
+def by_edge(fabric) -> dict[int, list[str]]:
+    out: dict[int, list[str]] = {}
+    for mac in sorted(fabric.hosts):
+        out.setdefault(fabric.hosts[mac].dpid, []).append(mac)
+    return out
+
+
+def ring_pairs(fabric) -> list[tuple[str, str]]:
+    macs = sorted(fabric.hosts)
+    return [(macs[i], macs[(i + 1) % len(macs)]) for i in range(8)]
+
+
+def shift_pairs(fabric) -> list[tuple[str, str]]:
+    """Both hosts of one edge switch bursting to two remote pods: the
+    deterministic installed shortest paths share the edge's one
+    lexicographically-first uplink, while a fresh balanced solve
+    spreads them — the routes-don't-fit-the-traffic scenario."""
+    edges = by_edge(fabric)
+    order = sorted(edges)
+    srcs = edges[order[0]]
+    dsts = [edges[e][0] for e in order[-2:]]
+    return [(s, d) for s in srcs for d in dsts]
+
+
+def sweep(controller, fabric, counts: dict) -> None:
+    """One flush edge with ``counts[(src, dst)]`` packets pumped first."""
+    for (src, dst), n in counts.items():
+        for _ in range(n):
+            fabric.hosts[src].send(of.Packet(src, dst, of.ETH_TYPE_IP))
+    controller.bus.publish(ev.EventStatsFlush())
+
+
+def frame_len(fabric, src: str, dst: str) -> float:
+    """Bytes per pumped frame, read off the source edge's own flow
+    counters — the fabric's ground truth, independent of the plane."""
+    dpid = fabric.hosts[src].dpid
+    for e in fabric.switches[dpid].flow_table:
+        if e.match.dl_src == src and e.match.dl_dst == dst:
+            assert e.packet_count > 0
+            return e.byte_count / e.packet_count
+    raise AssertionError("no counted row at the source edge")
+
+
+def matrix_cells(controller) -> dict[tuple[str, str, str], float]:
+    return {
+        (t, s, d): bps
+        for t, s, d, bps in controller.traffic.matrix()["cells"]
+    }
+
+
+# -- the measured matrix ---------------------------------------------------
+
+
+class TestTrafficMatrix:
+    def test_matrix_exact_at_alpha_one(self):
+        """The acceptance fence: a known injected pattern recovers
+        bit-exactly at EWMA alpha=1.0 — each cell equals the fabric's
+        own per-interval byte delta for that (tenant, src, dst)."""
+        fabric, controller = build()
+        edges = by_edge(fabric)
+        order = sorted(edges)
+        # distinct endpoints AND distinct per-pair packet counts, with
+        # a tenant split so the tenant dimension is fenced too
+        a, b = edges[order[0]]
+        c, d = edges[order[1]], edges[order[2]]
+        counts = {(a, c[0]): 3, (b, d[0]): 5, (c[1], d[1]): 2}
+        controller.router.admission.assign(a, "t0")
+        controller.router.admission.assign(b, "t1")
+        controller.router.reinstall_pairs(sorted(counts))
+        # constant per-sweep pattern: after the pull lag settles, every
+        # interval's attributed delta is identical, so the published
+        # matrix equals counts * frame_len regardless of lag phase
+        for _ in range(4):
+            sweep(controller, fabric, counts)
+        length = frame_len(fabric, a, c[0])
+        cells = matrix_cells(controller)
+        ep = controller.traffic.ep_name
+        expect = {
+            ("t0", ep(a), ep(c[0])): counts[(a, c[0])] * length,
+            ("t1", ep(b), ep(d[0])): counts[(b, d[0])] * length,
+            ("-", ep(c[1]), ep(d[1])): counts[(c[1], d[1])] * length,
+        }
+        assert cells == expect  # bit-exact: alpha=1.0, dt=1.0
+
+    def test_matrix_ewma_bounded_below_alpha_one(self):
+        """At alpha<1 the matrix converges geometrically toward the
+        injected constant rate and never overshoots it."""
+        fabric, controller = build(traffic_ewma_alpha=0.5)
+        (src, dst) = ring_pairs(fabric)[1]
+        controller.router.reinstall_pairs([(src, dst)])
+        counts = {(src, dst): 4}
+        for _ in range(6):
+            sweep(controller, fabric, counts)
+        target = counts[(src, dst)] * frame_len(fabric, src, dst)
+        ep = controller.traffic.ep_name
+        got = matrix_cells(controller)[("-", ep(src), ep(dst))]
+        # >= 2 EWMA folds have landed even under the one-interval pull
+        # lag: within (1-alpha)^2 of the target, never above it
+        assert target * (1.0 - 0.5 ** 2) - 1e-3 <= got <= target + 1e-3
+
+    def test_source_edge_attribution_counts_once(self):
+        """A multi-hop flow lands in the matrix once (source edge),
+        while the audit's per-row rollup counts every hop — the plane
+        total must be strictly smaller on multi-hop patterns."""
+        fabric, controller = build()
+        edges = by_edge(fabric)
+        order = sorted(edges)
+        src = edges[order[0]][0]
+        dst = edges[order[-1]][0]  # cross-pod: >= 4 switch rows
+        controller.router.admission.assign(src, "t0")
+        controller.router.reinstall_pairs([(src, dst)])
+        for _ in range(3):
+            sweep(controller, fabric, {(src, dst): 2})
+        plane = REGISTRY.get(
+            "trafficplane_tenant_bytes_total"
+        ).values.get("t0", 0)
+        fabric_total = REGISTRY.get(
+            "fabric_tenant_bytes_total"
+        ).values.get("t0", 0)
+        assert 0 < plane < fabric_total
+
+    def test_pod_mode_aggregates_endpoints(self):
+        fabric, controller = build(hier_oracle=True)
+        pairs = ring_pairs(fabric)
+        controller.router.reinstall_pairs(pairs)
+        for _ in range(3):
+            sweep(controller, fabric, {p: 1 for p in pairs})
+        matrix = controller.traffic.matrix()
+        assert matrix["mode"] == "pod"
+        assert matrix["cells"]
+        assert all(name.startswith("pod") for name in matrix["endpoints"])
+
+    def test_pull_provider_and_rpc_method(self):
+        fabric, controller = build()
+        pairs = ring_pairs(fabric)
+        controller.router.reinstall_pairs(pairs)
+        for _ in range(3):
+            sweep(controller, fabric, {p: 1 for p in pairs})
+        matrix = controller.bus.request(ev.TrafficMatrixRequest()).matrix
+        assert matrix["epoch"] >= 3 and matrix["cells"]
+        # ... and the same matrix over the JSON-RPC pull method
+        from sdnmpi_tpu.api.rpc import RPCInterface
+
+        rpc = RPCInterface(controller.bus, controller.config)
+        reply = rpc.handle_request(
+            {"jsonrpc": "2.0", "id": 1, "method": "traffic_matrix"}
+        )
+        assert reply["result"] == matrix
+
+    def test_disabled_plane_answers_off(self):
+        fabric, controller = build(traffic_plane=False)
+        assert controller.traffic is None and controller.sentinel is None
+        matrix = controller.bus.request(ev.TrafficMatrixRequest()).matrix
+        assert matrix["mode"] == "off" and matrix["cells"] == []
+
+
+# -- the sentinel ----------------------------------------------------------
+
+
+class TestSentinel:
+    def test_steady_replay_zero_false_positives(self):
+        """The acceptance fence: 250 steady flush edges of the uniform
+        ring never fire the sentinel and the divergence gauge never
+        crosses the factor."""
+        fabric, controller = build()
+        pairs = ring_pairs(fabric)
+        controller.router.reinstall_pairs(pairs)
+        counts = {p: 1 for p in pairs}
+        worst = 0.0
+        for _ in range(250):
+            sweep(controller, fabric, counts)
+            worst = max(
+                worst, controller.sentinel._last.get("divergence", 0.0)
+            )
+        assert dict(REGISTRY.get("sentinel_divergence_total").values) == {}
+        assert worst < controller.config.sentinel_divergence_factor
+        assert REGISTRY.get("sentinel_sweeps_total").value == 250
+
+    def test_shift_fires_within_two_sweeps_named_bundle(self):
+        """The acceptance fence: a mid-soak traffic-pattern shift fires
+        within <= 2 sweep periods, and the frozen flight bundle names
+        the diverging (tenant, pod-pair)."""
+        fabric, controller = build()
+        ring = ring_pairs(fabric)
+        shift = shift_pairs(fabric)
+        for src, _dst in shift:
+            controller.router.admission.assign(src, "bursty")
+        controller.router.reinstall_pairs(ring + shift)
+        for _ in range(5):
+            sweep(controller, fabric, {p: 1 for p in ring})
+        assert dict(REGISTRY.get("sentinel_divergence_total").values) == {}
+        fired_at = None
+        for i in range(1, 3):  # <= 2 sweep periods after the shift
+            sweep(controller, fabric, {p: 2 for p in shift})
+            if REGISTRY.get("sentinel_divergence_total").values:
+                fired_at = i
+                break
+        assert fired_at is not None and fired_at <= 2
+        detail = controller.sentinel.recent[-1]
+        assert detail["tenant"] == "bursty"
+        assert detail["pod_pair"][0] == controller.traffic.ep_name(
+            shift[0][0]
+        )
+        assert detail["divergence"] >= 1.5
+        # ... and the flight recorder froze a bundle for it, carrying
+        # the same naming detail
+        bundles = [
+            b for b in controller.flight.bundles
+            if b.get("trigger") == "sentinel:divergence"
+        ]
+        assert bundles
+        recent = bundles[-1]["detail"]["recent"]
+        assert recent and recent[-1]["tenant"] == "bursty"
+        assert recent[-1]["pod_pair"] == detail["pod_pair"]
+        # observe-only by default: nothing healed, nothing re-driven
+        assert REGISTRY.get("sentinel_heals_total").value == 0
+
+    def test_heal_optin_redrives_worst_pair(self):
+        fabric, controller = build(sentinel_heal=True)
+        shift = shift_pairs(fabric)
+        controller.router.reinstall_pairs(ring_pairs(fabric) + shift)
+        for _ in range(4):
+            sweep(controller, fabric, {p: 2 for p in shift})
+        assert REGISTRY.get("sentinel_heals_total").value >= 1
+
+    def test_broken_installed_walk_counts_stale(self):
+        fabric, controller = build()
+        pairs = ring_pairs(fabric)
+        controller.router.reinstall_pairs(pairs)
+        counts = {p: 1 for p in pairs}
+        for _ in range(3):
+            sweep(controller, fabric, counts)
+        assert REGISTRY.get("route_staleness_ratio").value == 0.0
+        # knock a hop out of one measured pair's desired chain: the
+        # walk breaks and the staleness gauge must say so
+        src, dst = next(
+            p for p in pairs
+            if fabric.hosts[p[0]].dpid != fabric.hosts[p[1]].dpid
+        )
+        dpid = fabric.hosts[src].dpid
+        controller.router.recovery.desired.remove(dpid, src, dst)
+        sweep(controller, fabric, counts)
+        assert REGISTRY.get("route_staleness_ratio").value > 0.0
+
+    def test_shadow_dispatch_zero_recompile_across_ladder(self):
+        """The pow2 bucketing fence: once the ladder is warm, shadow
+        re-scoring at ANY sample size inside it compiles nothing new."""
+        from sdnmpi_tpu.utils.tracing import TRACE_COUNTS
+
+        fabric, controller = build()
+        macs = sorted(fabric.hosts)
+        pool = [
+            (macs[i], macs[(i + j) % len(macs)])
+            for j in (1, 3) for i in range(len(macs))
+        ]
+        hop_map = controller.sentinel._hop_map()
+        ladder = (1, 2, 3, 5, 7, 8, 9, 13, 17, 25, 31)
+        for n in ladder:
+            controller.sentinel._shadow_links(pool[:n], hop_map)
+        warm = dict(TRACE_COUNTS)
+        for n in ladder:
+            controller.sentinel._shadow_links(pool[:n], hop_map)
+        assert dict(TRACE_COUNTS) == warm
+
+
+# -- the windowed congestion report (satellite) ----------------------------
+
+
+class TestWindowedReport:
+    def test_report_windows_not_lifetime(self):
+        from sdnmpi_tpu.control.audit import REPORT_WINDOW_SWEEPS
+
+        fabric, controller = build()
+        pairs = ring_pairs(fabric)
+        for src, _ in pairs:
+            controller.router.admission.assign(src, "t0")
+        controller.router.reinstall_pairs(pairs)
+        counts = {p: 1 for p in pairs}
+        for _ in range(REPORT_WINDOW_SWEEPS + 6):
+            sweep(controller, fabric, counts)
+        report = controller.audit.report()
+        assert report["window_sweeps"] == REPORT_WINDOW_SWEEPS
+        assert report["window_s"] > 0.0
+        lifetime = report["tenant_bytes_total"]["t0"]
+        windowed = report["tenant_bytes"]["t0"]
+        # more attributed sweeps than the window holds: the measured
+        # block must report the window's delta, not the lifetime sum
+        assert 0 < windowed < lifetime
+        assert report["tenant_bps"]["t0"] == pytest.approx(
+            windowed / report["window_s"]
+        )
+
+    def test_collective_entries_keep_windowed_and_lifetime(self):
+        from sdnmpi_tpu.control.loadgen import register_ranks
+        from sdnmpi_tpu.protocol.vmac import CollectiveType, VirtualMac
+
+        fabric, controller = build(
+            wire=False,
+            schedule_collectives=True,
+            block_install_threshold=2,
+        )
+        macs = sorted(fabric.hosts)[:4]
+        ranks = register_ranks(fabric, controller.config, macs)
+        vmac = VirtualMac(
+            CollectiveType.ALLTOALL, ranks[0], ranks[1]
+        ).encode()
+        h = fabric.hosts[macs[0]]
+        controller.bus.publish(ev.EventPacketIn(
+            h.dpid, h.port_no,
+            of.Packet(eth_src=macs[0], eth_dst=vmac,
+                      eth_type=of.ETH_TYPE_IP),
+            of.OFP_NO_BUFFER,
+        ))
+        controller.router.flush_routes()
+        installs = list(controller.router.collectives)
+        assert installs
+        inst = installs[0]
+        counts = {
+            (macs[int(s)], VirtualMac(
+                CollectiveType.ALLTOALL, ranks[int(s)], ranks[int(d)]
+            ).encode()): 1
+            for s, d in zip(inst.src_idx, inst.dst_idx)
+        }
+        for _ in range(4):
+            sweep(controller, fabric, counts)
+        report = controller.audit.report()
+        by_cookie = {c["cookie"]: c for c in report["collectives"]}
+        entry = by_cookie[inst.cookie]
+        assert entry["measured_bytes"] > 0
+        assert entry["measured_bytes_total"] >= entry["measured_bytes"]
+        assert entry["measured_bps"] > 0.0
+        assert entry["modeled_congestion"] >= 0.0
+
+
+# -- snapshot persistence (satellite) --------------------------------------
+
+
+class TestSnapshotPersistence:
+    def _soaked(self):
+        fabric, controller = build()
+        pairs = ring_pairs(fabric)
+        for src, _ in pairs:
+            controller.router.admission.assign(src, "t0")
+        controller.router.reinstall_pairs(pairs)
+        for _ in range(4):
+            sweep(controller, fabric, {p: 1 for p in pairs})
+        return fabric, controller, pairs
+
+    def test_snapshot_carries_baselines_and_matrix(self):
+        from sdnmpi_tpu.api.snapshot import snapshot_controller
+
+        fabric, controller, _pairs = self._soaked()
+        snap = snapshot_controller(controller)
+        aud = snap["audit_baselines"]
+        assert aud["rows"] and all(len(r) == 5 for r in aud["rows"])
+        assert aud["topology_digest"]
+        tp = snap["traffic_plane"]
+        assert tp["cells"] and tp["mode"] == "edge"
+        assert tp["topology_digest"] == aud["topology_digest"]
+        import json
+
+        json.dumps(snap)  # the checkpoint stays JSON-serializable
+
+    def test_restore_seeds_baselines_no_first_sweep_spike(self):
+        """The satellite's scenario: controller restarts over a warm
+        fabric. Restored baselines mean the first sweep attributes no
+        lifetime-counter spike, and the restored matrix serves the
+        sentinel before any fresh traffic."""
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+
+        fabric, controller, pairs = self._soaked()
+        live_cells = matrix_cells(controller)
+        snap = snapshot_controller(controller)
+        REGISTRY.reset()  # the restarted process starts at zero
+
+        c2 = Controller(fabric, controller.config)
+        fabric.connect(c2.bus)
+        restore_controller(c2, snap)
+        # mechanism: baselines and EWMA cells actually seeded
+        assert c2.audit._counters
+        assert matrix_cells(c2) == live_cells
+        # behavior: a traffic-free first sweep attributes ~nothing (a
+        # cold re-baseline would attribute every switch's lifetime
+        # counters as one giant fresh delta)
+        c2.bus.publish(ev.EventStatsFlush())
+        spike = REGISTRY.get("fabric_tenant_bytes_total").values.get(
+            "t0", 0
+        )
+        assert spike == 0
+
+    def test_restore_digest_guarded(self):
+        from sdnmpi_tpu.api.snapshot import (
+            restore_controller,
+            snapshot_controller,
+        )
+        from sdnmpi_tpu.topogen import linear
+
+        fabric, controller, _pairs = self._soaked()
+        snap = snapshot_controller(controller)
+        fabric2 = linear(4).to_fabric(wire=True)
+        c2 = Controller(fabric2, controller.config)
+        c2.attach()
+        restore_controller(c2, snap)
+        assert not c2.audit._counters  # different fabric: nothing seeds
+        assert matrix_cells(c2) == {}
+
+
+# -- bench registration fence (satellite) ----------------------------------
+
+
+class TestConfig17Fence:
+    def test_registered_and_committed(self):
+        import json
+        import pathlib
+
+        from benchmarks.run import CONFIGS
+
+        assert any(name == "17" for name, _cmd in CONFIGS)
+        suite = json.loads(
+            (pathlib.Path(__file__).resolve().parent.parent
+             / "BENCH_suite.json").read_text()
+        )
+        rows = [r for r in suite if r.get("config") == "17"]
+        assert rows, "config 17 has no committed baseline rows"
+        for row in rows:
+            assert {"config", "metric", "value", "unit"} <= set(row)
+
+    def test_detection_fence_at_test_scale(self):
+        from benchmarks.config17_traffic import measure_detection
+
+        sweeps = measure_detection(k=4)
+        assert sweeps <= 2
