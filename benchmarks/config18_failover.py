@@ -1,0 +1,226 @@
+"""Config 18: active/active pair — failover reconvergence + replication lag.
+
+The controller pair (control/replica.py, ISSUE 20) replicates the
+desired-flow store, the process registry, and the TopologyDB version
+chain between two controllers that split the switch space by the
+deterministic ownership partition; when one dies, the survivor adopts
+its shards and reconciles the fabric through the audit-verified
+re-drive path. This config prices both halves of that promise on a
+wire-mode fat-tree with a routed flow population:
+
+- ``failover_reconverge_ms`` (headline): wall from the moment the
+  survivor declares the peer's lease expired to ``installed ==
+  desired`` on every switch of the adopted shard — lease check,
+  epoch bump, adoption republishes, the budgeted reconcile re-drives
+  and the audit verify sweeps, end to end. vs_baseline is the
+  fresh-install wall for the same population over the reconverge
+  wall — below 1 is the price of going through the rate-shaped,
+  audit-verified adoption path instead of a blind bulk reinstall.
+- ``replication_lag_p99`` (extra row): p99 of the shipped-not-yet-
+  acked op-batch lag sampled after every mutation burst of a churn
+  storm with both replicas alive — the flight-recorder gauge the
+  triage loop watches, pinned here at its steady-state scale (the
+  tick-paced protocol acks every batch within one round trip, so the
+  healthy reading is 0 or 1).
+
+Wire-mode sim, LoopLink transport (the chaos-acceptance harness —
+launch mode rides the identical protocol over JSON-RPC relays).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from benchmarks.common import emit, log
+
+FATTREE_K = 8  # 80 switches, 128 hosts
+N_PAIRS = 256
+N_STORM_ROUNDS = 20
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def build(k: int = FATTREE_K, n_pairs: int = N_PAIRS):
+    """A wire-mode fat-tree under a controller pair with a routed pair
+    population replicated to both desired stores. Test-scale callers
+    shrink ``k``/``n_pairs``."""
+    from sdnmpi_tpu.config import Config
+    from sdnmpi_tpu.control.replica import build_pair
+    from sdnmpi_tpu.topogen import fattree
+
+    spec = fattree(k)
+    fabric = spec.to_fabric(wire=True)
+    config = Config(
+        enable_monitor=False,
+        coalesce_routes=True,
+        audit_switches_per_flush=0,
+        install_retry_backoff_s=0.0,
+        barrier_timeout_s=0.0,
+    )
+    clock = _Clock()
+    pair = build_pair(fabric, config, clock=clock)
+    pair.attach()
+
+    rng = np.random.default_rng(18)
+    hosts = sorted(fabric.hosts)
+    pairs = set()
+    while len(pairs) < min(n_pairs, len(hosts) * (len(hosts) - 1)):
+        a, b = rng.choice(len(hosts), size=2, replace=False)
+        pairs.add((hosts[a], hosts[b]))
+    pairs = sorted(pairs)
+    # each controller proactively installs the hops it owns; the
+    # replication stream converges both desired stores on the union
+    for c in pair.controllers:
+        c.router.reinstall_pairs(pairs)
+    _tick(pair, clock)
+    return spec, fabric, pair, clock, pairs
+
+
+def _tick(pair, clock, n: int = 3) -> None:
+    for _ in range(n):
+        clock.t += 1.0
+        for i, c in enumerate(pair.controllers):
+            if i not in pair.mux.dead:
+                c.replica.tick()
+
+
+def _installed(fabric):
+    out = set()
+    for d, sw in fabric.switches.items():
+        for e in sw.flow_table:
+            if e.match.dl_src is not None:
+                out.add((d, e.match.dl_src, e.match.dl_dst, e.actions,
+                         e.priority))
+    return out
+
+
+def _desired(controller):
+    from sdnmpi_tpu.protocol import openflow as of
+
+    cfg = controller.config
+    out = set()
+    for d, table in controller.router.recovery.desired.flows.items():
+        for (src, dst), spec in table.items():
+            actions: tuple = (of.ActionOutput(spec.out_port),)
+            if spec.rewrite:
+                actions = (of.ActionSetDlDst(spec.rewrite),) + actions
+            out.add((d, src, dst, actions, cfg.priority_default))
+    return out
+
+
+def storm_lag_samples(pair, clock, fabric, pairs,
+                      n_rounds: int = N_STORM_ROUNDS) -> list[int]:
+    """Replication lag sampled right after every mutation burst of a
+    churn storm (a fresh slice of host pairs routed every round — new
+    desired rows, so ops actually ship) — the worst moment of the
+    protocol's round trip."""
+    rng = np.random.default_rng(181)
+    hosts = sorted(fabric.hosts)
+    installed = set(pairs)
+    samples: list[int] = []
+    for r in range(n_rounds):
+        burst = []
+        while len(burst) < 16:
+            a, b = rng.choice(len(hosts), size=2, replace=False)
+            p = (hosts[a], hosts[b])
+            if p not in installed:
+                installed.add(p)
+                burst.append(p)
+        for c in pair.controllers:
+            c.router.reinstall_pairs(burst)
+        for c in pair.controllers:
+            c.replica.tick()  # ship the burst's op batch
+        for c in pair.controllers:
+            samples.append(c.replica.status()["lag"])  # pre-ack peak
+        _tick(pair, clock, n=2)  # heartbeats ack, lag drains
+    return samples
+
+
+def measure_failover(k: int = FATTREE_K, n_pairs: int = N_PAIRS):
+    """(reconverge_ms, fresh_install_ms, n_adopted): wall from lease
+    expiry to installed == desired under the survivor, vs the fresh
+    full-fabric install of the same population. The test-scale
+    regression fence calls this with a small ``k``."""
+    spec, fabric, pair, clock, pairs = build(k=k, n_pairs=n_pairs)
+
+    t0 = time.perf_counter()
+    for c in pair.controllers:
+        c.router.reinstall_pairs(pairs)
+    fresh_ms = (time.perf_counter() - t0) * 1e3
+    _tick(pair, clock)
+    assert _installed(fabric) == _desired(pair.controllers[0])
+
+    pair.kill(0)
+    surv = pair.controllers[1]
+    n_before = len(surv.router.dps)
+    clock.t += surv.config.replica_lease_timeout_s + 1.0
+    t0 = time.perf_counter()
+    surv.replica.tick()  # lease expiry + adoption scheduling
+    deadline = time.perf_counter() + 120.0
+    from sdnmpi_tpu.control import events as ev
+
+    while time.perf_counter() < deadline:
+        clock.t += surv.config.replica_adopt_backoff_s
+        surv.replica.tick()
+        fabric.release_stalls()
+        # the monitor is off (as in every bench config): publish its
+        # flush edge directly — anti-entropy, audit, the replica tick
+        surv.bus.publish(ev.EventStatsFlush())
+        if _installed(fabric) == _desired(surv):
+            break
+    reconverge_ms = (time.perf_counter() - t0) * 1e3
+    assert _installed(fabric) == _desired(surv), "failover never converged"
+    n_adopted = len(surv.router.dps) - n_before
+    assert n_adopted > 0, "the survivor adopted nothing"
+    return reconverge_ms, fresh_ms, n_adopted
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    spec, fabric, pair, clock, pairs = build()
+    n_flows = pair.controllers[0].router.recovery.desired.total()
+    log(
+        f"built fat-tree k={FATTREE_K} under a pair: "
+        f"{len(fabric.switches)} switches, {n_flows} replicated desired "
+        f"flows for {len(pairs)} pairs ({time.perf_counter() - t0:.1f}s)"
+    )
+
+    samples = storm_lag_samples(pair, clock, fabric, pairs)
+    lag_p99 = float(np.percentile(samples, 99))
+    log(f"replication lag over {len(samples)} storm samples: "
+        f"p99 {lag_p99:.1f} batches (max {max(samples)})")
+
+    reconverge_ms, fresh_ms, n_adopted = measure_failover()
+    log(
+        f"failover: {n_adopted} switches adopted, installed == desired "
+        f"in {reconverge_ms:.1f} ms (fresh install of the same "
+        f"population: {fresh_ms:.1f} ms)"
+    )
+
+    emit(
+        "failover_reconverge_ms", reconverge_ms, "ms",
+        vs_baseline=fresh_ms / reconverge_ms if reconverge_ms else 0.0,
+        fresh_install_ms=round(fresh_ms, 3),
+        n_adopted_switches=n_adopted,
+        n_switches=len(fabric.switches),
+        n_desired_flows=n_flows,
+    )
+    emit(
+        "replication_lag_p99", lag_p99, "batches",
+        vs_baseline=1.0,  # no reference figure: one controller, no lag
+        n_samples=len(samples),
+        lag_max=int(max(samples)),
+        storm_rounds=N_STORM_ROUNDS,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -97,6 +97,12 @@ SOAK_EXEMPT = {
     "serving_warmup_seconds",  # --warm-serving
     "compile_cache_",  # --compile-cache-dir
     "fabric_",  # wire-mode byte counters (lint soaks the sim fabric)
+    "replica_",  # active/active pair plane (--replica-peer)
+    "replication_lag",  # pair plane gauge
+    "ownership_epoch",  # pair plane gauge
+    # incident/failure counters: zero IS the healthy reading
+    "snapshot_cold_starts_total",
+    "sentinel_heals_throttled_total",
 }
 
 

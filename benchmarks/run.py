@@ -42,6 +42,7 @@ CONFIGS = [
     ("15", [sys.executable, "-m", "benchmarks.config15_hier"]),
     ("16", [sys.executable, "-m", "benchmarks.config16_audit"]),
     ("17", [sys.executable, "-m", "benchmarks.config17_traffic"]),
+    ("18", [sys.executable, "-m", "benchmarks.config18_failover"]),
 ]
 
 #: keys every successful suite row must carry (error rows carry

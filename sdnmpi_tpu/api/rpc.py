@@ -68,6 +68,10 @@ class RPCInterface:
         self.bus = bus
         self.config = config
         self.clients: list[RPCClient] = []
+        #: replication ingest hook (ISSUE 20): launch.py points this at
+        #: RpcReplicaLink.ingest so inbound ``replica_relay``
+        #: notifications feed the replica plane's inbox
+        self.on_replica_relay = None
 
         bus.subscribe(ev.EventProcessAdd, lambda e: self._broadcast("add_process", e.rank, e.mac))
         bus.subscribe(ev.EventProcessDelete, lambda e: self._broadcast("delete_process", e.rank))
@@ -204,6 +208,8 @@ class RPCInterface:
                      lambda reply: reply.timeline),
         "traffic_matrix": (lambda params: ev.TrafficMatrixRequest(),
                            lambda reply: reply.matrix),
+        "replica_status": (lambda params: ev.ReplicaStatusRequest(),
+                           lambda reply: reply.status),
     }
 
     def handle_request(self, message: dict):
@@ -216,7 +222,16 @@ class RPCInterface:
             return None
         msg_id = message.get("id")
         if msg_id is None:
-            return None  # notification: nothing to answer
+            # notifications: nothing to answer. The one we act on is
+            # the replica pair's replication stream (ISSUE 20) — each
+            # ``replica_relay`` notification carries one protocol
+            # message for the peer's RpcReplicaLink inbox.
+            if message.get("method") == "replica_relay":
+                ingest = self.on_replica_relay
+                params = message.get("params")
+                if ingest is not None and params:
+                    ingest(params[0])
+            return None
         method = message.get("method")
         entry = self.PULL_METHODS.get(method)
         if entry is None:

@@ -12,13 +12,36 @@ controller resumes with warm state instead of a blank network view.
 from __future__ import annotations
 
 import json
+import logging
 import pathlib
 
 import numpy as np
 
 from sdnmpi_tpu.core.topology_db import Host, Link, Port, Switch
+from sdnmpi_tpu.utils.metrics import REGISTRY
+
+log = logging.getLogger(__name__)
 
 SNAPSHOT_VERSION = 1
+
+_m_cold_starts = REGISTRY.counter(
+    "snapshot_cold_starts_total",
+    "checkpoint restores abandoned or partially skipped (version or "
+    "digest mismatch) in favor of a cold start",
+)
+
+
+def _cold_start(controller, reason: str) -> None:
+    """A restore section did not match this controller's world: log it,
+    count it, and drop a breadcrumb on the bus (the flight recorder's
+    event tail picks it up) — never raise. A replica bootstrapping
+    from a stale snapshot must degrade to rediscovery, not crash-loop
+    (ISSUE 20 satellite)."""
+    from sdnmpi_tpu.control import events as ev
+
+    log.warning("snapshot restore degraded to cold start: %s", reason)
+    _m_cold_starts.inc()
+    controller.bus.publish(ev.EventSnapshotColdStart(reason))
 
 
 def snapshot_controller(controller) -> dict:
@@ -122,7 +145,11 @@ def snapshot_controller(controller) -> dict:
 
 def restore_controller(controller, snapshot: dict) -> None:
     if snapshot.get("version") != SNAPSHOT_VERSION:
-        raise ValueError(f"unsupported snapshot version {snapshot.get('version')}")
+        _cold_start(
+            controller,
+            f"unsupported snapshot version {snapshot.get('version')}",
+        )
+        return
 
     # Live discovery is authoritative for topology: once attach() has
     # populated any switches, merging the snapshot would resurrect links
@@ -176,6 +203,8 @@ def restore_controller(controller, snapshot: dict) -> None:
                     int(dpid), src, dst, int(out_port), rewrite,
                     bool(collective),
                 )
+        else:
+            _cold_start(controller, "desired-flow topology digest mismatch")
 
     # Re-seed the audit plane's counter baselines (ISSUE 19 satellite)
     # under the same digest guard: the first post-restore sweep then
@@ -188,26 +217,26 @@ def restore_controller(controller, snapshot: dict) -> None:
 
     aud = snapshot.get("audit_baselines")
     audit = getattr(controller, "audit", None)
-    if (
-        aud and audit is not None
-        and aud.get("topology_digest") == RouteCache.topology_digest(db)
-    ):
-        audit.cycle = int(aud.get("cycle", 0))
-        for dpid, src, dst, pkts, bts in aud.get("rows", []):
-            audit._counters.setdefault(int(dpid), {})[(src, dst)] = (
-                int(pkts), int(bts)
-            )
+    if aud and audit is not None:
+        if aud.get("topology_digest") == RouteCache.topology_digest(db):
+            audit.cycle = int(aud.get("cycle", 0))
+            for dpid, src, dst, pkts, bts in aud.get("rows", []):
+                audit._counters.setdefault(int(dpid), {})[(src, dst)] = (
+                    int(pkts), int(bts)
+                )
+        else:
+            _cold_start(controller, "audit-baseline topology digest mismatch")
 
     # ... and the measured traffic matrix's EWMA state, so the sentinel
     # scores against the learned matrix instead of a blank one until
     # traffic re-accumulates
     tp = snapshot.get("traffic_plane")
     traffic = getattr(controller, "traffic", None)
-    if (
-        tp and traffic is not None
-        and tp.get("topology_digest") == RouteCache.topology_digest(db)
-    ):
-        traffic.load_state(tp)
+    if tp and traffic is not None:
+        if tp.get("topology_digest") == RouteCache.topology_digest(db):
+            traffic.load_state(tp)
+        else:
+            _cold_start(controller, "traffic-plane topology digest mismatch")
 
     # Re-seed the route-cache memo BEFORE any re-routing below: the
     # reinstall passes then hit the restored entries (hit == miss

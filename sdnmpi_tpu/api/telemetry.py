@@ -166,6 +166,8 @@ INSTRUMENTED_MODULES = (
     "sdnmpi_tpu.control.topology_manager",
     "sdnmpi_tpu.control.fabric",
     "sdnmpi_tpu.control.sentinel",
+    "sdnmpi_tpu.control.replica",
+    "sdnmpi_tpu.api.snapshot",
     "sdnmpi_tpu.oracle.trafficplane",
     "sdnmpi_tpu.oracle.engine",
     "sdnmpi_tpu.oracle.utilplane",
@@ -207,8 +209,12 @@ METRIC_OWNERS = (
     ("oracle_", "oracle/engine"),
     ("pipeline_", "control/router"),
     ("profile_", "utils/devprof"),
+    ("ownership_", "control/replica"),
     ("reconcile_", "control/recovery"),
     ("recovery_", "control/recovery"),
+    ("replica_", "control/replica"),
+    ("replication_", "control/replica"),
+    ("snapshot_", "api/snapshot"),
     ("reval_", "control/router"),
     ("ring_", "shardplane"),
     ("route_cache_", "oracle/routecache"),

@@ -455,6 +455,29 @@ class Config:
     #: aborted (echo_timeouts_total counts the kills)
     echo_timeout_s: float = 45.0
 
+    # --- active/active controller pair (control/replica.py; ISSUE 20) -----
+    #: peer controller's RPC WebSocket URL ("" = single controller: no
+    #: replica plane is constructed and the serving path is unchanged —
+    #: the default-off acceptance pin)
+    replica_peer: str = ""
+    #: replicas in the pair (the ownership partition's modulus); the
+    #: plane is built for N but the shipped transports wire a pair
+    replica_count: int = 2
+    #: this replica's index in the mesh's (process_index, id) order;
+    #: -1 derives it from jax.process_index (ownership.mesh_replica_index)
+    replica_index: int = -1
+    #: lease heartbeat period, riding the EventStatsFlush/echo cadence
+    replica_lease_interval_s: float = 1.0
+    #: silence after which a peer's lease is declared expired and its
+    #: shards are adopted (epoch bump + reconcile-on-adopt)
+    replica_lease_timeout_s: float = 3.0
+    #: jitter base for reconcile-on-adopt republishes (seeded draw via
+    #: recovery.jitter, uniform in [0, base/4)): a pair-wide failover
+    #: de-synchronizes instead of thundering-herding the fabric
+    replica_adopt_backoff_s: float = 2.0
+    #: targeted peer-row re-drives per replica tick; 0 = unshaped
+    replica_redrive_per_tick: int = 0
+
     # --- api -------------------------------------------------------------
     #: WebSocket JSON-RPC mirror bind address (reference serves
     #: /v1.0/sdnmpi/ws via Ryu's WSGI server, sdnmpi/rpc_interface.py:104)

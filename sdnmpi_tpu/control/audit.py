@@ -56,6 +56,7 @@ import collections
 import time
 from typing import Optional
 
+from sdnmpi_tpu.control.ownership import is_owner_cookie
 from sdnmpi_tpu.protocol import openflow as of
 from sdnmpi_tpu.utils.metrics import LATENCY_BUCKETS_S, REGISTRY
 from sdnmpi_tpu.utils.tracing import start_span
@@ -383,9 +384,12 @@ class AuditPlane:
         confirm, heal. Returns confirmed-divergence records — or None
         when the switch could not be audited this pass (the caller
         re-queues verify requests on None)."""
-        if self.recovery.in_flight(dpid):
+        # recovery owns this gap; auditing it is noise — a reconcile
+        # parked in the rate-shaping FIFO (e.g. an ISSUE-20 adoption
+        # re-drive mid-air) counts as in flight
+        if self.recovery.in_flight(dpid) or dpid in self.router._reconcile_pending:
             _m_skipped.inc()
-            return None  # recovery owns this gap; auditing it is noise
+            return None
         entries = self.southbound.flow_stats(dpid)
         if entries is None:
             _m_skipped.inc()
@@ -395,10 +399,14 @@ class AuditPlane:
         for e in entries:
             m = e.match
             if (
-                e.priority != prio or e.cookie
+                e.priority != prio
+                or (e.cookie and not is_owner_cookie(e.cookie))
                 or m.dl_src is None or m.dl_dst is None
             ):
-                continue  # bootstrap/control rules and block-plane rows
+                # bootstrap/control rules and block-plane rows;
+                # ownership-epoch cookies on unicast rows (ISSUE 20)
+                # stay in scope — cookie is 0 with the pair off
+                continue
             installed[(m.dl_src, m.dl_dst)] = (
                 _parse_row_actions(e.actions), e.packet_count, e.byte_count
             )

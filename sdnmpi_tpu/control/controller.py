@@ -26,6 +26,9 @@ class Controller:
         self,
         southbound,
         config: Config = DEFAULT_CONFIG,
+        *,
+        ownership=None,
+        replica_link=None,
     ) -> None:
         self.config = config
         self.bus = EventBus()
@@ -172,6 +175,23 @@ class Controller:
                 ev.EventStatsFlush, lambda e: self._traffic_tick()
             )
 
+        # active/active replica plane (ISSUE 20): store replication +
+        # lease failover, ticking on the same EventStatsFlush edge as
+        # the audit sweep above (and before the flight recorder below,
+        # so a failover's adoption events land in the same pass's
+        # trigger sweep). Default-off: without an ownership map and a
+        # peer link nothing is constructed.
+        self.ownership = ownership
+        self.replica = None
+        if ownership is not None and replica_link is not None:
+            from sdnmpi_tpu.control.replica import ReplicaPlane
+
+            self.replica = ReplicaPlane(self, ownership, replica_link, config)
+            self.bus.subscribe(
+                ev.EventStatsFlush, lambda e: self.replica.tick()
+            )
+        self.bus.provide(ev.ReplicaStatusRequest, self._replica_status)
+
         # anomaly-armed profiler capture (ISSUE 14): a firing trigger
         # opens a jax.profiler window for profile_capture_s seconds
         self.profile_capture = None
@@ -233,6 +253,10 @@ class Controller:
                 # and the context carries the measured matrix (ISSUE 19)
                 flight.triggers.append(self.sentinel.trigger())
                 flight.add_context("traffic", self.sentinel.forensics)
+            if self.replica is not None:
+                # failover forensics: ownership map, sequence numbers,
+                # replication lag at the moment a bundle froze (ISSUE 20)
+                flight.add_context("replica", self.replica.status)
             flight.on_anomaly = self._publish_anomaly
             flight.arm()
             self.bus.tap(flight.event_tap)
@@ -358,6 +382,16 @@ class Controller:
             else {"epoch": 0, "mode": "off", "endpoints": [], "cells": []}
         )
         return ev.TrafficMatrixReply(matrix)
+
+    def _replica_status(self, req) -> "object":
+        from sdnmpi_tpu.control import events as ev
+
+        status = (
+            self.replica.status()
+            if self.replica is not None
+            else {"mode": "off"}
+        )
+        return ev.ReplicaStatusReply(status)
 
     def _publish_anomaly(self, bundle: dict) -> None:
         """Flight-recorder anomaly hook -> one EventAnomaly on the bus

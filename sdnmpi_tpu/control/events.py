@@ -566,3 +566,50 @@ class EventStatsFlush(Event):
     pass has been published. Utilization consumers use this edge to
     flush their staged samples as ONE vectorized batch (the device
     utilization plane scatters once per pass, not once per port)."""
+
+
+# -- active/active replica pair (ISSUE 20) --------------------------------
+
+
+@dataclasses.dataclass
+class EventPeerLeaseExpired(Event):
+    """A peer replica's lease lapsed (no heartbeat for the timeout):
+    this controller is about to adopt its shards. Flight-recorder
+    breadcrumb for the failover timeline."""
+
+    replica: int
+
+
+@dataclasses.dataclass
+class EventShardAdopted(Event):
+    """One shard of the switch partition changed hands: ``replica``
+    now serves ``shard`` at the bumped fencing ``epoch`` — every
+    subsequent FlowMod to the shard carries the new epoch cookie."""
+
+    shard: int
+    epoch: int
+    replica: int
+
+
+@dataclasses.dataclass
+class EventSnapshotColdStart(Event):
+    """A checkpoint restore was abandoned (version or digest mismatch)
+    and the controller is starting cold instead of crash-looping —
+    reactive discovery re-teaches it the fabric (ISSUE 20 satellite)."""
+
+    reason: str
+
+
+@dataclasses.dataclass
+class ReplicaStatusRequest(Request):
+    """The replica plane's replication/failover posture: ownership
+    map, sequence numbers, lag, lease state. Provided by the
+    Controller; the ``replica_status`` pull RPC rides it. Mode is
+    "off" on a single controller (``--replica-peer`` unset)."""
+
+    dst = "Controller"
+
+
+@dataclasses.dataclass
+class ReplicaStatusReply(Reply):
+    status: dict

@@ -136,6 +136,14 @@ class DesiredFlowStore:
     def __init__(self) -> None:
         self.flows: dict[int, dict[tuple[str, str], FlowSpec]] = {}
         self._count = 0
+        #: replication seam (ISSUE 20): when set, every effective
+        #: mutation is reported as one op tuple —
+        #: ``("record", dpid, src, dst, out_port, rewrite, collective)``
+        #: with the values actually STORED (first-writer-wins ownership
+        #: included), or ``("remove", dpid, src, dst)`` for a row that
+        #: existed. None (the default) costs one attribute load per
+        #: mutation — the single-controller path is unchanged.
+        self.on_mutate = None
 
     def record(
         self, dpid: int, src: str, dst: str, out_port: int,
@@ -151,11 +159,17 @@ class DesiredFlowStore:
         # a phased program's byte-identical row would otherwise hand it
         # flow timeouts on the next reconcile (and the reverse would
         # strip the FDB bookkeeping)
-        table[(src, dst)] = FlowSpec(
+        spec = FlowSpec(
             int(out_port), rewrite,
             collective if prev is None else prev.collective,
         )
+        table[(src, dst)] = spec
         _m_desired_flows.set(self._count)
+        if self.on_mutate is not None:
+            self.on_mutate((
+                "record", dpid, src, dst, spec.out_port, spec.rewrite,
+                spec.collective,
+            ))
 
     def record_many(
         self, dpids, srcs, dsts, out_ports, rewrites,
@@ -166,6 +180,7 @@ class DesiredFlowStore:
         whole phase's rows (flagship scale: ~1e6 per program) here
         instead of a scalar call per row."""
         flows = self.flows
+        on_mutate = self.on_mutate
         fresh = 0
         for dpid, src, dst, port, rewrite in zip(
             dpids, srcs, dsts, out_ports, rewrites
@@ -178,10 +193,16 @@ class DesiredFlowStore:
             # reactive flow can be byte-identical to a phase row (the
             # kickoff packet's), and stealing it would strip its
             # SwitchFDB bookkeeping on the next reconcile
-            table[(src, dst)] = FlowSpec(
+            spec = FlowSpec(
                 int(port), rewrite,
                 collective if prev is None else prev.collective,
             )
+            table[(src, dst)] = spec
+            if on_mutate is not None:
+                on_mutate((
+                    "record", int(dpid), src, dst, spec.out_port,
+                    spec.rewrite, spec.collective,
+                ))
         self._count += fresh
         _m_desired_flows.set(self._count)
 
@@ -193,6 +214,8 @@ class DesiredFlowStore:
         if not table:
             del self.flows[dpid]
         _m_desired_flows.set(self._count)
+        if self.on_mutate is not None:
+            self.on_mutate(("remove", int(dpid), src, dst))
 
     def has(self, dpid: int, src: str, dst: str) -> bool:
         return (src, dst) in self.flows.get(dpid, {})
@@ -288,7 +311,7 @@ class RecoveryPlane:
                                   resync=rows is None)
                 and self.on_exhausted is not None
             ):
-                self.on_exhausted(dpid)
+                self.on_exhausted(dpid, now)
 
     def ack(self, dpid: int, xid: int, now: float | None = None) -> None:
         """An OFPT_BARRIER_REPLY (EventBarrierAck) arrived."""
@@ -356,13 +379,22 @@ class RecoveryPlane:
             retry.deletes |= set(deletes)
         if resync:
             retry.resync = True
-        backoff = (
-            self.config.install_retry_backoff_s
-            * (2 ** (attempt - 1))
-            * (1.0 + 0.25 * self._rng.random())
-        )
-        retry.due = now + backoff
+        backoff = self.config.install_retry_backoff_s * (2 ** (attempt - 1))
+        retry.due = now + backoff + self.jitter(backoff)
         return True
+
+    def jitter(self, base: float) -> float:
+        """One seeded jitter draw over ``base`` seconds: uniform in
+        ``[0, base / 4)``. The shared de-synchronizer (ISSUE 20
+        satellite) — retry backoff, retry-exhaustion wipe-resyncs, and
+        reconcile-on-adopt all draw from this one seeded stream, so
+        simultaneous failures spread instead of thundering-herd the
+        install plane, and a seeded test replays the exact schedule.
+        ``base <= 0`` draws nothing and returns 0 (the FAST_RECOVERY /
+        zero-backoff test path stays byte-identical)."""
+        if base <= 0:
+            return 0.0
+        return base * 0.25 * self._rng.random()
 
     def pop_due(self, now: float) -> list[tuple[int, _Retry]]:
         """Remove and return every retry whose backoff elapsed. The

@@ -310,6 +310,9 @@ class Router:
         #: once must not re-drive every desired set in one burst
         self._reconcile_pending: list[int] = []
         self._reconcile_spent = 0
+        #: jitter-deferred wipe-resync republishes (ISSUE 20
+        #: satellite): (due, dpid) pairs drained by recovery_tick
+        self._resync_due: list[tuple[float, int]] = []
 
         bus.subscribe(ev.EventDatapathUp, self._datapath_up)
         bus.subscribe(ev.EventDatapathDown, self._datapath_down)
@@ -1911,6 +1914,20 @@ class Router:
                 continue  # went away again; reconcile-on-up will re-queue
             self._reconcile_spent += 1
             self._reconcile_datapath(dpid)
+        if self._resync_due:
+            # jitter-deferred wipe-resync republishes (ISSUE 20
+            # satellite): the EventDatapathUp re-drive lands through the
+            # same budgeted reconcile path above, staggered by the
+            # seeded draw taken at escalation time
+            ready = [x for x in self._resync_due if x[0] <= now]
+            if ready:
+                self._resync_due = [x for x in self._resync_due if x[0] > now]
+                for _t, dpid in sorted(ready):
+                    if dpid not in self.dps:
+                        continue
+                    self.bus.publish(ev.EventDatapathUp(dpid))
+                    if self.audit is not None:
+                        self.audit.request_verify(dpid)
         for dpid, (rows, resync) in self.recovery.expire_barriers(
             now, self.config.barrier_timeout_s
         ).items():
@@ -1920,7 +1937,7 @@ class Router:
             if not self.recovery.schedule(
                 dpid, now, deletes=rows, resync=resync
             ):
-                self._resync_datapath(dpid)
+                self._resync_datapath(dpid, now)
         for dpid, retry in self.recovery.pop_due(now):
             if dpid not in self.dps:
                 # reconcile-on-up owns dead datapaths; unconfirmed
@@ -1970,12 +1987,12 @@ class Router:
                     now=now, dpid=dpid, deletes=set(deletes),
                     resync=retry.resync,
                 ):
-                    self._resync_datapath(dpid)
+                    self._resync_datapath(dpid, now)
             finally:
                 sp.end(ok=ok)
                 _m_recovery_redrive_s.observe(time.perf_counter() - t0)
 
-    def _resync_datapath(self, dpid: int) -> None:
+    def _resync_datapath(self, dpid: int, now: float | None = None) -> None:
         """Last-resort escalation after retry exhaustion: wipe the
         switch's flow table with an all-wildcard OFPFC_DELETE (the OF
         1.0 "forget everything" idiom) and republish EventDatapathUp so
@@ -1983,7 +2000,13 @@ class Router:
         its bootstrap flows, the ProcessManager its announcement trap,
         this Router the desired set — exactly as on a redial. The
         switch's state is then known-good again regardless of which
-        windows it lost."""
+        windows it lost.
+
+        The republish is staggered by one seeded jitter draw over the
+        retry backoff base (ISSUE 20 satellite: a fabric-wide
+        exhaustion storm — or a pair failover — must not re-drive
+        every switch in lockstep); with a zero backoff base (the
+        synchronous-test posture) it stays immediate."""
         if dpid not in self.dps:
             return
         self.recovery.note_resync()
@@ -1998,6 +2021,11 @@ class Router:
                 match=of.Match(), actions=(), priority=0,
                 command=of.OFPFC_DELETE,
             ))
+            delay = self.recovery.jitter(self.config.install_retry_backoff_s)
+            if delay > 0.0:
+                now = time.monotonic() if now is None else now
+                self._resync_due.append((now + delay, dpid))
+                return  # recovery_tick republishes (+ verify) when due
             self.bus.publish(ev.EventDatapathUp(dpid))
         finally:
             sp.end()

@@ -78,6 +78,12 @@ _m_heals = REGISTRY.counter(
     "worst diverging pairs re-driven through the install plane "
     "(Config.sentinel_heal opt-in)",
 )
+_m_heals_throttled = REGISTRY.counter(
+    "sentinel_heals_throttled_total",
+    "sentinel heals deferred because the tenant's admission token "
+    "bucket was empty (the heal re-drive must not starve tenant "
+    "serving traffic)",
+)
 
 #: hop bound for the installed-path walk — anything longer is a loop
 _WALK_MAX = 64
@@ -316,8 +322,15 @@ class RouteSentinel:
         self.recent.append(detail)
         self._unreported.append(detail)
         if self.config.sentinel_heal:
-            self.router.reinstall_pairs([(src, dst)])
-            _m_heals.inc()
+            # the heal re-drive spends the tenant's admission tokens
+            # like any reactive route (ISSUE 20 satellite): a healing
+            # storm competes with — never starves — serving traffic.
+            # With no rate armed admit() is always True (unchanged).
+            if self.router.admission.admit(src):
+                self.router.reinstall_pairs([(src, dst)])
+                _m_heals.inc()
+            else:
+                _m_heals_throttled.inc()
 
     def _worst_collective(self) -> Optional[int]:
         """Cookie of the collective moving the most measured bytes over

@@ -147,6 +147,9 @@ def config_from_args(args) -> Config:
         # LLDP discovery is the ONLY link/host source in real-switch
         # mode (the simulated fabric's direct announcements don't exist)
         log.info("--listen implies --observe-links; enabling discovery")
+    replica_index, replica_count = parse_ownership(
+        getattr(args, "ownership", None)
+    )
     return Config(
         oracle_backend=args.backend,
         enable_monitor=args.profile != "no-monitor",
@@ -208,6 +211,11 @@ def config_from_args(args) -> Config:
         coalesce_routes=getattr(args, "tenants", 0) > 0,
         slo_targets=_slo_targets(getattr(args, "slo_target", None)),
         profile_dump_dir=getattr(args, "profile_dump", None) or "",
+        replica_peer=getattr(args, "replica_peer", None) or "",
+        replica_index=replica_index,
+        replica_count=replica_count,
+        replica_lease_interval_s=getattr(args, "lease_interval", 1.0),
+        replica_lease_timeout_s=getattr(args, "lease_timeout", 3.0),
     )
 
 
@@ -228,6 +236,26 @@ def _slo_targets(specs) -> dict:
     return out
 
 
+def parse_ownership(spec) -> tuple[int, int]:
+    """``--ownership I/N`` -> (replica_index, replica_count); raises
+    SystemExit on malformed input so a typo fails the launch instead of
+    two replicas silently claiming the same shards. None (flag absent)
+    -> (-1, 2): the index derives from the mesh's process order
+    (ownership.mesh_replica_index)."""
+    if not spec:
+        return -1, 2
+    try:
+        idx_s, cnt_s = str(spec).split("/", 1)
+        idx, cnt = int(idx_s), int(cnt_s)
+    except ValueError:
+        raise SystemExit(f"--ownership wants I/N, e.g. 0/2 (got {spec!r})")
+    if cnt < 1 or not 0 <= idx < cnt:
+        raise SystemExit(
+            f"--ownership wants 0 <= I < N with N >= 1 (got {spec!r})"
+        )
+    return idx, cnt
+
+
 def parse_distributed(spec: str) -> tuple[str, int, int]:
     """'HOST:PORT,NPROC,RANK' -> (coordinator, n_processes, process_id)
     for shardplane.mesh.init_multihost; raises SystemExit on malformed
@@ -246,6 +274,53 @@ def parse_distributed(spec: str) -> tuple[str, int, int]:
             f"0 <= RANK < NPROC (got {spec!r})"
         )
     return coordinator, nproc, rank
+
+
+async def run_replica_relay(controller, link, config) -> None:
+    """Outbound half of the pair's replication stream (ISSUE 20): dial
+    the peer's RPC WebSocket, relay the link's sends as
+    ``replica_relay`` notifications, and drive the replica tick at the
+    lease cadence — the async twin of the echo keepalive loop.
+    Reconnects forever; sends while disconnected drop, and the
+    sequence-gap protocol snapshot-backfills once the peer is back."""
+    import json
+
+    outbox: asyncio.Queue = asyncio.Queue(maxsize=4096)
+
+    def enqueue(msg: dict) -> None:
+        # QueueFull propagates into RpcReplicaLink.send's drop counter:
+        # a wedged peer link opens a gap instead of growing unbounded
+        outbox.put_nowait(json.dumps({
+            "jsonrpc": "2.0", "method": "replica_relay", "params": [msg],
+        }))
+
+    link.bind_sender(enqueue)
+
+    async def pump() -> None:
+        import websockets
+
+        while True:
+            try:
+                async with websockets.connect(
+                    config.replica_peer
+                ) as ws:
+                    log.info("replica peer link up: %s", config.replica_peer)
+                    while True:
+                        await ws.send(await outbox.get())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                await asyncio.sleep(
+                    max(0.5, config.replica_lease_interval_s)
+                )
+
+    pump_task = asyncio.create_task(pump())
+    try:
+        while True:
+            controller.replica.tick()
+            await asyncio.sleep(max(0.1, config.replica_lease_interval_s))
+    finally:
+        pump_task.cancel()
 
 
 async def amain(args) -> None:
@@ -296,7 +371,33 @@ async def amain(args) -> None:
             wire=args.wire,
             discovery="packet" if args.observe_links else "direct",
         )
-    controller = Controller(fabric, config)
+    ownership = None
+    replica_link = None
+    if config.replica_peer:
+        # active/active pair (ISSUE 20): deterministic switch partition
+        # by the mesh's process order, replication + lease heartbeats
+        # relayed over the peer's RPC WebSocket
+        from sdnmpi_tpu.control.ownership import (
+            OwnershipMap,
+            mesh_replica_index,
+        )
+        from sdnmpi_tpu.control.replica import FencedSouthbound, RpcReplicaLink
+
+        index = (
+            config.replica_index if config.replica_index >= 0
+            else mesh_replica_index(config.replica_count)
+        )
+        ownership = OwnershipMap(config.replica_count, index)
+        replica_link = RpcReplicaLink()
+        fabric = FencedSouthbound(fabric, ownership, shared=False)
+        log.info(
+            "replica %d/%d: serving shards %s, peer %s",
+            index, config.replica_count, ownership.shards_of(index),
+            config.replica_peer,
+        )
+    controller = Controller(
+        fabric, config, ownership=ownership, replica_link=replica_link
+    )
     controller.attach()
 
     if args.restore:
@@ -380,7 +481,18 @@ async def amain(args) -> None:
         from sdnmpi_tpu.api.rpc import RPCInterface
 
         rpc = RPCInterface(controller.bus, config)
+        if replica_link is not None:
+            # inbound half of the replication stream: the peer's
+            # replica_relay notifications land in the link's inbox
+            rpc.on_replica_relay = replica_link.ingest
         tasks.append(asyncio.create_task(rpc.serve()))
+    elif replica_link is not None:
+        log.warning("--replica-peer with --no-rpc: no inbound relay "
+                    "endpoint; this replica can send but never receive")
+    if replica_link is not None:
+        tasks.append(asyncio.create_task(
+            run_replica_relay(controller, replica_link, config)
+        ))
 
     from sdnmpi_tpu.utils.tracing import STATS, device_trace
 
@@ -656,6 +768,28 @@ def build_parser() -> argparse.ArgumentParser:
         "--echo-timeout", type=float, default=45.0,
         help="seconds without an echo reply before a half-open "
         "datapath is disconnected",
+    )
+    parser.add_argument(
+        "--replica-peer", default=None,
+        help="peer controller's RPC WebSocket URL (e.g. "
+        "ws://host:8080/v1.0/sdnmpi/ws): run as one replica of an "
+        "active/active pair — switch ownership is partitioned, stores "
+        "replicate, and a dead peer's shards are adopted (unset = "
+        "single controller, unchanged serving path)",
+    )
+    parser.add_argument(
+        "--ownership", default=None,
+        help="this replica's slot as I/N (e.g. 0/2); omit to derive "
+        "the index from the mesh's process order",
+    )
+    parser.add_argument(
+        "--lease-interval", type=_pos_float, default=1.0,
+        help="replica lease heartbeat period, seconds",
+    )
+    parser.add_argument(
+        "--lease-timeout", type=_pos_float, default=3.0,
+        help="seconds of peer silence before its lease expires and "
+        "its shards are adopted (epoch bump + reconcile-on-adopt)",
     )
     parser.add_argument(
         "--no-fabric-audit", action="store_true",
